@@ -1,0 +1,21 @@
+"""llmd-tpu: a TPU-native distributed LLM inference framework.
+
+Re-implements the capabilities of llm-d (reference: /root/reference) TPU-first:
+
+- ``llmd_tpu.engine``    — JAX/Pallas serving engine (continuous batching, paged KV,
+  chunked prefill, TP/DP/EP via pjit+shard_map over a ``jax.sharding.Mesh``).
+- ``llmd_tpu.models``    — model families (dense Llama-style, MoE Qwen/DeepSeek-style).
+- ``llmd_tpu.ops``       — Pallas TPU kernels (ragged paged attention, MoE grouped GEMM).
+- ``llmd_tpu.parallel``  — mesh/sharding layer: TP, DP, EP all-to-all, sequence parallel.
+- ``llmd_tpu.router``    — the EPP equivalent: parsers, data layer, Filter→Score→Pick
+  scheduler, flow control, disaggregation profile handler.
+- ``llmd_tpu.kv``        — KV-cache plane: event bus, prefix indexer, offload tiers.
+- ``llmd_tpu.disagg``    — P/D disaggregation: routing sidecar + KV-transfer connector.
+
+The reference is a Kubernetes-native orchestration stack over vLLM (llm-d
+docs/architecture/README.md:5-64); here both the orchestration layer AND the engine are
+provided, with the engine built TPU-native (XLA collectives over ICI/DCN instead of
+NCCL/NVSHMEM/NIXL).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,33 @@
+"""Core contracts shared by every subsystem (SURVEY.md §7 phase 1).
+
+Pure-Python, hardware-free: request/response model, endpoint attribute model,
+the model-server metrics contract, the KV-event schema, and the plugin-graph
+configuration system.
+"""
+
+from llmd_tpu.core.request import (  # noqa: F401
+    InferenceRequest,
+    SamplingParams,
+    RequestOutcome,
+    HDR_OBJECTIVE,
+    HDR_FAIRNESS_ID,
+    HDR_MODEL_REWRITE,
+    HDR_SLO_TTFT_MS,
+    HDR_SLO_TPOT_MS,
+    HDR_PREFILLER_HOST_PORT,
+)
+from llmd_tpu.core.endpoint import Endpoint, AttributeMap, EndpointRole  # noqa: F401
+from llmd_tpu.core.metrics_contract import (  # noqa: F401
+    StdMetric,
+    METRIC_MAPPINGS,
+    map_engine_metrics,
+)
+from llmd_tpu.core.kv_events import (  # noqa: F401
+    BlockStored,
+    BlockRemoved,
+    AllBlocksCleared,
+    encode_event_batch,
+    decode_event_batch,
+    kv_topic,
+)
+from llmd_tpu.core.config import FrameworkConfig, ConfigError  # noqa: F401

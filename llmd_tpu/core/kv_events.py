@@ -1,0 +1,130 @@
+"""KV-event schema + wire format (ZMQ PUB/SUB, msgpack payload).
+
+Parity: reference docs/architecture/advanced/kv-management/kv-indexer.md:59-63 — engines
+publish BlockStored (chained parent hash, token chunk, LoRA, multimodal extra keys, tier),
+BlockRemoved, AllBlocksCleared whenever KV-cache state changes. Topic format
+``kv@<pod_ip:port>@<model>`` (precise-prefix-cache-routing/README.md:300-307). Delivery is
+either centralized (router binds, engines connect) or pod-discovery (each engine binds;
+router subscribes per pod → active-active HA), kv-indexer.md:67-87.
+
+Block-key chaining: key_i = H(key_{i-1} ‖ tokens_i ‖ lora ‖ mm_extra), so a block is only
+reusable behind its unbroken prefix chain.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence, Union
+
+import msgpack
+
+MEDIUM_HBM = "gpu"  # tier names kept from the reference for scorer-weight parity
+MEDIUM_CPU = "cpu"
+MEDIUM_FS = "fs"
+
+
+@dataclass
+class BlockStored:
+    block_hashes: list[int]
+    parent_block_hash: Optional[int]
+    token_ids: list[int]  # concatenated token chunk covered by these blocks
+    block_size: int
+    lora_id: Optional[str] = None
+    medium: str = MEDIUM_HBM
+    # Multimodal extra keys folded into hashing (kv-indexer.md:146-151).
+    extra_keys: list[bytes] = field(default_factory=list)
+
+
+@dataclass
+class BlockRemoved:
+    block_hashes: list[int]
+    medium: str = MEDIUM_HBM
+
+
+@dataclass
+class AllBlocksCleared:
+    pass
+
+
+KVEvent = Union[BlockStored, BlockRemoved, AllBlocksCleared]
+
+_TAGS = {"BlockStored": 0, "BlockRemoved": 1, "AllBlocksCleared": 2}
+
+
+def kv_topic(pod_address: str, model: str) -> str:
+    return f"kv@{pod_address}@{model}"
+
+
+def encode_event_batch(events: Sequence[KVEvent], seq: int = 0) -> bytes:
+    """Encode an event batch: msgpack [seq, [tagged event, ...]]."""
+    rows = []
+    for ev in events:
+        if isinstance(ev, BlockStored):
+            rows.append([
+                _TAGS["BlockStored"], ev.block_hashes, ev.parent_block_hash,
+                ev.token_ids, ev.block_size, ev.lora_id, ev.medium, ev.extra_keys,
+            ])
+        elif isinstance(ev, BlockRemoved):
+            rows.append([_TAGS["BlockRemoved"], ev.block_hashes, ev.medium])
+        elif isinstance(ev, AllBlocksCleared):
+            rows.append([_TAGS["AllBlocksCleared"]])
+        else:  # pragma: no cover
+            raise TypeError(f"unknown event {ev!r}")
+    return msgpack.packb([seq, rows], use_bin_type=True)
+
+
+def decode_event_batch(data: bytes) -> tuple[int, list[KVEvent]]:
+    seq, rows = msgpack.unpackb(data, raw=False)
+    out: list[KVEvent] = []
+    for row in rows:
+        tag = row[0]
+        if tag == _TAGS["BlockStored"]:
+            out.append(BlockStored(
+                block_hashes=list(row[1]), parent_block_hash=row[2],
+                token_ids=list(row[3]), block_size=row[4], lora_id=row[5],
+                medium=row[6], extra_keys=list(row[7]),
+            ))
+        elif tag == _TAGS["BlockRemoved"]:
+            out.append(BlockRemoved(block_hashes=list(row[1]), medium=row[2]))
+        elif tag == _TAGS["AllBlocksCleared"]:
+            out.append(AllBlocksCleared())
+    return seq, out
+
+
+def hash_block_tokens(
+    parent_hash: Optional[int],
+    token_ids: Sequence[int],
+    lora_id: Optional[str] = None,
+    extra_keys: Iterable[bytes] = (),
+) -> int:
+    """Content hash of one KV block, chained to its parent (dual-key design).
+
+    Stable across processes (sha256-based, not Python hash()) so router-side computed keys
+    match engine-published ones.
+    """
+    h = hashlib.sha256()
+    h.update(struct.pack("<q", -1 if parent_hash is None else parent_hash))
+    h.update(struct.pack(f"<{len(token_ids)}i", *token_ids))
+    if lora_id:
+        h.update(lora_id.encode())
+    for k in extra_keys:
+        h.update(k)
+    return struct.unpack("<q", h.digest()[:8])[0]
+
+
+def block_keys_for_tokens(
+    token_ids: Sequence[int],
+    block_size: int,
+    lora_id: Optional[str] = None,
+    mm_hashes: Iterable[bytes] = (),
+) -> list[int]:
+    """Chained block keys for a full token sequence (only complete blocks are keyed)."""
+    keys: list[int] = []
+    parent: Optional[int] = None
+    mm = list(mm_hashes)
+    for i in range(0, len(token_ids) - len(token_ids) % block_size, block_size):
+        parent = hash_block_tokens(parent, token_ids[i : i + block_size], lora_id, mm)
+        keys.append(parent)
+    return keys

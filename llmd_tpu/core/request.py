@@ -1,0 +1,142 @@
+"""Inference request model + the llm-d HTTP header contract.
+
+Parity targets:
+- header names: reference docs/api-reference/epp-http-headers.md:5-20
+- InferenceRequest fields: reference docs/architecture/core/router/epp/request-handling.md:50-86
+- flow-control outcome → HTTP status map: reference
+  docs/architecture/core/router/epp/flow-control.md:310-344
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Optional, Sequence
+
+# HTTP header contract (x-llm-d-*), kept verbatim for drop-in client compatibility.
+HDR_OBJECTIVE = "x-llm-d-inference-objective"
+HDR_FAIRNESS_ID = "x-llm-d-inference-fairness-id"
+HDR_MODEL_REWRITE = "x-llm-d-model-name-rewrite"
+HDR_SLO_TTFT_MS = "x-llm-d-slo-ttft-ms"
+HDR_SLO_TPOT_MS = "x-llm-d-slo-tpot-ms"
+HDR_PREFILLER_HOST_PORT = "x-prefiller-host-port"
+
+
+def flatten_messages(messages: Sequence[dict[str, Any]]) -> str:
+    """Canonical chat→text flattening shared by router, engine, and test fixture.
+
+    Router-side block keys are computed over this rendering, so every component MUST use
+    this one helper (divergence silently breaks prefix-cache scoring).
+    """
+    return "\n".join(f"{m.get('role', '')}: {m.get('content', '')}" for m in messages)
+
+
+@dataclass
+class SamplingParams:
+    """OpenAI-compatible sampling parameters understood by the engine."""
+
+    max_tokens: int = 16
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    min_p: float = 0.0
+    stop: Sequence[str] = ()
+    stop_token_ids: Sequence[int] = ()
+    seed: Optional[int] = None
+    logprobs: Optional[int] = None
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+    ignore_eos: bool = False
+    n: int = 1
+
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+class RequestOutcome(str, Enum):
+    """Flow-control dispatch outcomes and their HTTP mapping.
+
+    Reference flow-control.md:310-344: queue-full → 429, TTL-expiry/disconnect → 503,
+    shutdown → 500, dispatched → forwarded.
+    """
+
+    DISPATCHED = "dispatched"
+    REJECTED_CAPACITY = "rejected_capacity"  # → 429
+    EVICTED_TTL = "evicted_ttl"  # → 503
+    EVICTED_DISCONNECT = "evicted_disconnect"  # → 503
+    EVICTED_SHUTDOWN = "evicted_shutdown"  # → 500
+
+    @property
+    def http_status(self) -> int:
+        return {
+            RequestOutcome.DISPATCHED: 200,
+            RequestOutcome.REJECTED_CAPACITY: 429,
+            RequestOutcome.EVICTED_TTL: 503,
+            RequestOutcome.EVICTED_DISCONNECT: 503,
+            RequestOutcome.EVICTED_SHUTDOWN: 500,
+        }[self]
+
+
+@dataclass
+class InferenceRequest:
+    """A parsed inference request flowing through the router.
+
+    Built by a Parser (openai/grpc/passthrough — request-handling.md:50-73); enriched by
+    DataProducers (token ids, prefix-block keys, predicted latency); consumed by the
+    Filter→Score→Pick scheduler.
+    """
+
+    request_id: str = field(default_factory=lambda: uuid.uuid4().hex)
+    model: str = ""
+    # One of prompt (text) / messages (chat) / token_ids (pre-tokenized).
+    prompt: Optional[str] = None
+    messages: Optional[list[dict[str, Any]]] = None
+    token_ids: Optional[list[int]] = None
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    streaming: bool = False
+    arrival_time: float = field(default_factory=time.monotonic)
+
+    # Header-derived routing state.
+    objective: Optional[str] = None  # InferenceObjective name → priority band
+    fairness_id: str = ""  # FlowKey = (fairness_id, priority)
+    priority: int = 0
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+    lora_adapter: Optional[str] = None
+    # Multimodal content hashes folded into block keys (kv-indexer.md:146-151).
+    mm_hashes: list[bytes] = field(default_factory=list)
+
+    # Producer-attached state (typed scratch shared across plugins).
+    state: dict[str, Any] = field(default_factory=dict)
+
+    # Approximate request size for flow-control byte accounting.
+    byte_size: int = 0
+
+    def prompt_text(self) -> str:
+        if self.prompt is not None:
+            return self.prompt
+        if self.messages is not None:
+            return flatten_messages(self.messages)
+        return ""
+
+    def flow_key(self) -> tuple[str, int]:
+        return (self.fairness_id, self.priority)
+
+    @classmethod
+    def from_headers(cls, headers: dict[str, str], **kw: Any) -> "InferenceRequest":
+        req = cls(**kw)
+        get = {k.lower(): v for k, v in headers.items()}.get
+        req.objective = get(HDR_OBJECTIVE)
+        req.fairness_id = get(HDR_FAIRNESS_ID, "") or ""
+        # Malformed client-supplied SLO headers are ignored, not fatal.
+        for hdr, attr in ((HDR_SLO_TTFT_MS, "slo_ttft_ms"), (HDR_SLO_TPOT_MS, "slo_tpot_ms")):
+            raw = get(hdr)
+            if raw:
+                try:
+                    setattr(req, attr, float(raw))
+                except ValueError:
+                    pass
+        return req

@@ -1,0 +1,1 @@
+"""Test fixtures: fake model server, workload generators (SURVEY.md §4)."""

@@ -1,0 +1,258 @@
+"""Fake model server: the control plane's hardware-free test fixture.
+
+Implements the full model-server contract the router depends on (SURVEY.md §4):
+
+- OpenAI HTTP API: ``/v1/completions``, ``/v1/chat/completions`` (+streaming)
+- render/tokenize endpoints: ``/v1/completions/render`` (kv-indexer.md:104-113)
+- Prometheus ``/metrics`` with the vLLM-compatible names (model-servers.md:38-52)
+- ``/health`` liveness/readiness (model-servers.md:81-86)
+- ZMQ KV-event publishing with a simulated paged prefix cache (kv-indexer.md:59-87)
+
+Timing model: prefill cost ∝ uncached prompt tokens, decode cost ∝ output tokens, so
+prefix-cache-aware routing measurably beats round-robin in tests — mirroring the
+reference's optimized-baseline benchmark design (BASELINE.md row 7).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional
+
+from aiohttp import web
+
+import zmq
+import zmq.asyncio
+
+from llmd_tpu.core.kv_events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    block_keys_for_tokens,
+    encode_event_batch,
+    kv_topic,
+)
+from llmd_tpu.core.request import flatten_messages
+
+
+def fake_tokenize(text: str) -> list[int]:
+    """Deterministic byte-level tokenizer shared by fixture and router tests."""
+    return list(text.encode("utf-8"))
+
+
+@dataclass
+class FakeServerConfig:
+    model: str = "fake/model"
+    block_size: int = 16
+    num_blocks: int = 512
+    prefill_us_per_token: float = 50.0  # uncached prompt tokens
+    decode_us_per_token: float = 500.0
+    max_running: int = 8
+    kv_events_port: Optional[int] = None  # bind tcp://*:port when set (pod-discovery mode)
+    role: str = "both"  # prefill | decode | both
+    lora_adapters: list[str] = field(default_factory=list)
+
+
+class FakeModelServer:
+    def __init__(self, cfg: FakeServerConfig, host: str = "127.0.0.1", port: int = 0):
+        self.cfg = cfg
+        self.host, self.port = host, port
+        self.running = 0
+        self.queued = 0
+        self.request_count = 0
+        # Simulated paged prefix cache: block_hash → last-use (LRU).
+        self.blocks: OrderedDict[int, float] = OrderedDict()
+        self._zctx = None
+        self._pub = None
+        self._seq = 0
+        self._runner: Optional[web.AppRunner] = None
+        self._admit = asyncio.Semaphore(cfg.max_running)
+        self.received: list[dict] = []  # request log for assertions
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self._completions)
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions/render", self._render)
+        app.router.add_post("/v1/chat/completions/render", self._render)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/v1/models", self._models)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        if self.cfg.kv_events_port is not None:
+            self._zctx = zmq.asyncio.Context()
+            self._pub = self._zctx.socket(zmq.PUB)
+            if self.cfg.kv_events_port == 0:
+                self.cfg.kv_events_port = self._pub.bind_to_random_port("tcp://127.0.0.1")
+            else:
+                self._pub.bind(f"tcp://127.0.0.1:{self.cfg.kv_events_port}")
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+        if self._pub is not None:
+            self._pub.close(0)
+            self._zctx.term()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- KV cache simulation ----------------------------------------------
+    async def _publish(self, events) -> None:
+        if self._pub is None:
+            return
+        self._seq += 1
+        topic = kv_topic(self.address, self.cfg.model).encode()
+        await self._pub.send_multipart([topic, encode_event_batch(events, self._seq)])
+
+    async def _touch_blocks(self, token_ids: list[int], lora: Optional[str]) -> int:
+        """Insert/refresh blocks for tokens; publish events; return cached-prefix len."""
+        keys = block_keys_for_tokens(token_ids, self.cfg.block_size, lora)
+        cached = 0
+        for k in keys:
+            if k in self.blocks:
+                cached += 1
+            else:
+                break
+        now = time.monotonic()
+        stored, removed = [], []
+        parent = keys[cached - 1] if cached else None
+        new_keys = keys[cached:]
+        for k in keys:
+            self.blocks[k] = now
+            self.blocks.move_to_end(k)
+        while len(self.blocks) > self.cfg.num_blocks:
+            old, _ = self.blocks.popitem(last=False)
+            removed.append(old)
+        if new_keys:
+            chunk = token_ids[cached * self.cfg.block_size : len(keys) * self.cfg.block_size]
+            stored.append(BlockStored(
+                block_hashes=new_keys, parent_block_hash=parent, token_ids=chunk,
+                block_size=self.cfg.block_size, lora_id=lora,
+            ))
+        events = stored + ([BlockRemoved(block_hashes=removed)] if removed else [])
+        if events:
+            await self._publish(events)
+        return cached * self.cfg.block_size
+
+    async def clear_cache(self) -> None:
+        self.blocks.clear()
+        await self._publish([AllBlocksCleared()])
+
+    # -- handlers ----------------------------------------------------------
+    async def _serve_generation(self, request: web.Request, prompt: str, body: dict, chat: bool):
+        lora = body.get("model") if body.get("model") in self.cfg.lora_adapters else None
+        token_ids = fake_tokenize(prompt)
+        max_tokens = int(body.get("max_tokens", 16))
+        stream = bool(body.get("stream", False))
+        self.request_count += 1
+        self.received.append({"prompt": prompt, "body": body, "t": time.monotonic()})
+
+        self.queued += 1
+        async with self._admit:  # FIFO-ish admission, no busy-wait
+            self.queued -= 1
+            self.running += 1
+            try:
+                cached = await self._touch_blocks(token_ids, lora)
+                uncached = max(0, len(token_ids) - cached)
+                prefill_s = uncached * self.cfg.prefill_us_per_token / 1e6
+                tpot_s = self.cfg.decode_us_per_token / 1e6
+                # kv_transfer_params flow for P/D (disaggregation/README.md:104-131).
+                kv_params = body.get("kv_transfer_params") or {}
+                rid = f"cmpl-{uuid.uuid4().hex[:12]}"
+                model = body.get("model", self.cfg.model)
+                usage = {
+                    "prompt_tokens": len(token_ids), "completion_tokens": max_tokens,
+                    "total_tokens": len(token_ids) + max_tokens, "cached_tokens": cached,
+                }
+
+                if stream:
+                    resp = web.StreamResponse(headers={"Content-Type": "text/event-stream"})
+                    await resp.prepare(request)
+                    await asyncio.sleep(prefill_s)
+                    for i in range(max_tokens):
+                        await asyncio.sleep(tpot_s)
+                        chunk = {
+                            "id": rid, "model": model, "created": int(time.time()),
+                            "object": "chat.completion.chunk" if chat else "text_completion",
+                            "choices": [
+                                {"index": 0, "delta": {"content": f"t{i} "}}
+                                if chat else {"index": 0, "text": f"t{i} "}
+                            ],
+                        }
+                        if i == max_tokens - 1:
+                            chunk["usage"] = usage
+                        await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    await resp.write(b"data: [DONE]\n\n")
+                    await resp.write_eof()
+                    return resp
+
+                await asyncio.sleep(prefill_s + max_tokens * tpot_s)
+                text = f"echo({len(token_ids)}t,{max_tokens}o)"
+                out: dict = {
+                    "id": rid, "object": "chat.completion" if chat else "text_completion",
+                    "model": model, "created": int(time.time()), "usage": usage,
+                    "choices": [
+                        {"index": 0, "message": {"role": "assistant", "content": text}}
+                        if chat else {"index": 0, "text": text, "finish_reason": "length"}
+                    ],
+                }
+                if kv_params.get("do_remote_decode"):
+                    out["kv_transfer_params"] = {
+                        "remote_host": self.host, "remote_port": self.port,
+                        "remote_request_id": rid, "remote_block_ids": list(range(len(token_ids) // self.cfg.block_size)),
+                    }
+                return web.json_response(out)
+            finally:
+                self.running -= 1
+
+    async def _completions(self, request: web.Request):
+        body = await request.json()
+        return await self._serve_generation(request, str(body.get("prompt", "")), body, chat=False)
+
+    async def _chat(self, request: web.Request):
+        body = await request.json()
+        prompt = flatten_messages(body.get("messages", []))
+        return await self._serve_generation(request, prompt, body, chat=True)
+
+    async def _render(self, request: web.Request) -> web.Response:
+        body = await request.json()
+        if "messages" in body:
+            prompt = flatten_messages(body.get("messages", []))
+        else:
+            prompt = str(body.get("prompt", ""))
+        return web.json_response({"prompt_token_ids": fake_tokenize(prompt)})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        util = min(1.0, len(self.blocks) / self.cfg.num_blocks)
+        lines = [
+            f"vllm:num_requests_waiting {self.queued}",
+            f"vllm:num_requests_running {self.running}",
+            f"vllm:kv_cache_usage_perc {util:.6f}",
+            f'vllm:cache_config_info{{block_size="{self.cfg.block_size}",num_gpu_blocks="{self.cfg.num_blocks}"}} 1',
+        ]
+        if self.cfg.lora_adapters:
+            running = ",".join(self.cfg.lora_adapters[:1])
+            lines.append(
+                f'vllm:lora_requests_info{{max_lora="4",running_lora_adapters="{running}",'
+                f'waiting_lora_adapters=""}} {time.time():.3f}'
+            )
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        data = [{"id": self.cfg.model, "object": "model"}]
+        data += [{"id": a, "object": "model", "parent": self.cfg.model} for a in self.cfg.lora_adapters]
+        return web.json_response({"object": "list", "data": data})

@@ -1,0 +1,145 @@
+"""Unit tests for core contracts: headers, metrics mapping, KV events, config graph."""
+
+import pytest
+
+from llmd_tpu.core import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    ConfigError,
+    FrameworkConfig,
+    InferenceRequest,
+    RequestOutcome,
+    decode_event_batch,
+    encode_event_batch,
+    map_engine_metrics,
+)
+from llmd_tpu.core.kv_events import block_keys_for_tokens, hash_block_tokens
+from llmd_tpu.core.metrics_contract import StdMetric, parse_prometheus
+
+
+def test_headers_parsed():
+    req = InferenceRequest.from_headers(
+        {
+            "X-LLM-D-Inference-Objective": "premium",
+            "x-llm-d-inference-fairness-id": "tenant-a",
+            "x-llm-d-slo-ttft-ms": "250",
+            "x-llm-d-slo-tpot-ms": "40",
+        },
+        model="m",
+        prompt="hi",
+    )
+    assert req.objective == "premium"
+    assert req.fairness_id == "tenant-a"
+    assert req.slo_ttft_ms == 250.0 and req.slo_tpot_ms == 40.0
+    assert req.flow_key() == ("tenant-a", 0)
+
+
+def test_outcome_http_map():
+    # flow-control.md:310-344
+    assert RequestOutcome.REJECTED_CAPACITY.http_status == 429
+    assert RequestOutcome.EVICTED_TTL.http_status == 503
+    assert RequestOutcome.EVICTED_SHUTDOWN.http_status == 500
+
+
+def test_metrics_mapping_vllm_and_sglang():
+    text = """
+# HELP whatever
+vllm:num_requests_waiting 3
+vllm:num_requests_running 5
+vllm:kv_cache_usage_perc 0.42
+vllm:cache_config_info{block_size="16",num_gpu_blocks="1024"} 1
+vllm:lora_requests_info{max_lora="4",running_lora_adapters="a1, a2",waiting_lora_adapters=""} 171.5
+"""
+    out = map_engine_metrics("vllm", parse_prometheus(text))
+    assert out[StdMetric.QUEUED_REQUESTS] == 3
+    assert out[StdMetric.RUNNING_REQUESTS] == 5
+    assert out[StdMetric.KV_UTILIZATION] == pytest.approx(0.42)
+    assert out[StdMetric.BLOCK_SIZE] == 16 and out[StdMetric.NUM_BLOCKS] == 1024
+    assert out[StdMetric.LORA_INFO]["running"] == ["a1", "a2"]
+
+    sg = map_engine_metrics("sglang", parse_prometheus("sglang:num_queue_reqs 7\nsglang:token_usage 0.9"))
+    assert sg[StdMetric.QUEUED_REQUESTS] == 7
+    assert sg[StdMetric.KV_UTILIZATION] == pytest.approx(0.9)
+
+
+def test_kv_event_roundtrip():
+    events = [
+        BlockStored(block_hashes=[1, 2], parent_block_hash=None, token_ids=list(range(32)),
+                    block_size=16, lora_id="ad1", medium="gpu", extra_keys=[b"img"]),
+        BlockRemoved(block_hashes=[9], medium="cpu"),
+        AllBlocksCleared(),
+    ]
+    seq, out = decode_event_batch(encode_event_batch(events, seq=42))
+    assert seq == 42
+    assert isinstance(out[0], BlockStored) and out[0].block_hashes == [1, 2]
+    assert out[0].extra_keys == [b"img"] and out[0].lora_id == "ad1"
+    assert isinstance(out[1], BlockRemoved) and out[1].medium == "cpu"
+    assert isinstance(out[2], AllBlocksCleared)
+
+
+def test_block_key_chaining():
+    toks = list(range(64))
+    keys = block_keys_for_tokens(toks, 16)
+    assert len(keys) == 4
+    # chained: same tokens with different parent produce different keys
+    assert hash_block_tokens(None, toks[:16]) == keys[0]
+    assert hash_block_tokens(keys[0], toks[16:32]) == keys[1]
+    assert hash_block_tokens(None, toks[16:32]) != keys[1]
+    # lora scoping changes the chain (kv-indexer.md LoRA section)
+    assert block_keys_for_tokens(toks, 16, lora_id="a")[0] != keys[0]
+    # partial blocks are not keyed
+    assert len(block_keys_for_tokens(toks[:17], 16)) == 1
+
+
+CFG = """
+plugins:
+  - name: prefix
+    type: prefix-cache-scorer
+    params: {blockSize: 16}
+  - name: queue
+    type: queue-depth-scorer
+schedulingProfiles:
+  - name: default
+    plugins:
+      - pluginRef: prefix
+        weight: 3
+      - pluginRef: queue
+        weight: 2
+"""
+
+
+def test_config_parse_and_picker_injection():
+    cfg = FrameworkConfig.from_yaml(CFG)
+    prof = cfg.scheduling_profiles[0]
+    assert prof.plugins[0].weight == 3.0
+    # max-score picker auto-injected (configuration.md:150-166)
+    names = [r.plugin_ref for r in prof.plugins]
+    assert "max-score-picker" in names
+    assert cfg.plugin("prefix").params["blockSize"] == 16
+
+
+def test_config_validation_errors():
+    with pytest.raises(ConfigError):
+        FrameworkConfig.from_yaml("""
+plugins:
+  - {name: a, type: x}
+  - {name: a, type: y}
+""")
+    with pytest.raises(ConfigError):
+        FrameworkConfig.from_yaml("""
+plugins: [{name: a, type: x}]
+schedulingProfiles:
+  - name: p
+    plugins: [{pluginRef: missing}]
+""")
+    with pytest.raises(ConfigError):
+        FrameworkConfig.from_yaml("plugins: [{name: a, type: weird}]",
+                                  known_types={"known"})
+
+
+def test_default_profile_autocreated():
+    cfg = FrameworkConfig.from_yaml("plugins: [{name: q, type: queue-depth-scorer}]")
+    assert cfg.scheduling_profiles[0].name == "default"
+    refs = [r.plugin_ref for r in cfg.scheduling_profiles[0].plugins]
+    assert refs[0] == "q"

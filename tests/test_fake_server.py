@@ -1,0 +1,70 @@
+"""Fixture self-test: fake model server honors the metrics + KV-event contracts."""
+
+import asyncio
+
+import zmq
+import zmq.asyncio
+
+from llmd_tpu.core.kv_events import BlockStored, block_keys_for_tokens, decode_event_batch
+from llmd_tpu.core.metrics_contract import StdMetric, map_engine_metrics, parse_prometheus
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig, fake_tokenize
+from tests.conftest import run_async
+
+import aiohttp
+
+
+async def _scenario():
+    srv = FakeModelServer(FakeServerConfig(kv_events_port=0, block_size=16))
+    await srv.start()
+    try:
+        sub_ctx = zmq.asyncio.Context()
+        sub = sub_ctx.socket(zmq.SUB)
+        sub.connect(f"tcp://127.0.0.1:{srv.cfg.kv_events_port}")
+        sub.setsockopt(zmq.SUBSCRIBE, b"kv@")
+        await asyncio.sleep(0.2)  # let SUB join
+
+        prompt = "x" * 64
+        async with aiohttp.ClientSession() as sess:
+            r = await sess.post(
+                f"http://{srv.address}/v1/completions",
+                json={"prompt": prompt, "max_tokens": 4, "model": "fake/model"},
+            )
+            body = await r.json()
+            assert body["usage"]["prompt_tokens"] == 64
+            assert body["usage"]["cached_tokens"] == 0
+
+            # second identical request hits the prefix cache
+            r = await sess.post(
+                f"http://{srv.address}/v1/completions",
+                json={"prompt": prompt, "max_tokens": 4, "model": "fake/model"},
+            )
+            body = await r.json()
+            assert body["usage"]["cached_tokens"] == 64
+
+            # render endpoint tokenization contract
+            r = await sess.post(
+                f"http://{srv.address}/v1/completions/render", json={"prompt": prompt}
+            )
+            assert (await r.json())["prompt_token_ids"] == fake_tokenize(prompt)
+
+            # metrics contract parses to standard keys
+            r = await sess.get(f"http://{srv.address}/metrics")
+            out = map_engine_metrics("vllm", parse_prometheus(await r.text()))
+            assert out[StdMetric.BLOCK_SIZE] == 16
+            assert StdMetric.KV_UTILIZATION in out
+
+        # KV event arrived with the chained keys the router would compute itself
+        topic, payload = await asyncio.wait_for(sub.recv_multipart(), timeout=5)
+        assert topic.decode().startswith(f"kv@{srv.address}@")
+        _, events = decode_event_batch(payload)
+        assert isinstance(events[0], BlockStored)
+        expect = block_keys_for_tokens(fake_tokenize(prompt), 16)
+        assert events[0].block_hashes == expect
+        sub.close(0)
+        sub_ctx.term()
+    finally:
+        await srv.stop()
+
+
+def test_fake_server_contracts():
+    run_async(_scenario())
