@@ -1,0 +1,10 @@
+"""TPU serving engine: continuous batching over a paged KV cache.
+
+The counterpart of vLLM in the reference stack (docs/architecture/core/model-servers.md)
+— but JAX/XLA-native: two jitted programs (chunked prefill, batched decode) with fully
+static shapes, a host-side page allocator with content-hash prefix reuse (KV-event
+publishing per kv-indexer.md:59-63), and mesh sharding from llmd_tpu.parallel.
+"""
+
+from llmd_tpu.engine.config import EngineConfig  # noqa: F401
+from llmd_tpu.engine.engine import LLMEngine, EngineOutput  # noqa: F401

@@ -1,0 +1,32 @@
+"""Engine configuration (the vLLM flag-surface analogue, TPU-shaped)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from llmd_tpu.parallel.mesh import MeshConfig
+
+
+@dataclass
+class EngineConfig:
+    # Paged KV cache — page_size matches the reference's --block-size contract
+    # (precise-prefix-cache-routing values: blockSize must equal engine block size).
+    page_size: int = 16
+    num_pages: int = 512
+    max_model_len: int = 2048
+    # Continuous batching
+    max_batch_size: int = 8  # decode slots
+    prefill_chunk: int = 128  # chunked-prefill token budget per step
+    enable_prefix_caching: bool = True
+    # Parallelism
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    # Scheduling
+    max_queue: int = 1024
+    # KV offload tier (pages of CPU-side cache; 0 = disabled) — K3 equivalent.
+    cpu_offload_pages: int = 0
+    # P/D role (disaggregation/README.md roles kv_producer/kv_consumer/both)
+    role: str = "both"
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return (self.max_model_len + self.page_size - 1) // self.page_size

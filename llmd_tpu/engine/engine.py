@@ -1,0 +1,385 @@
+"""LLMEngine: continuous batching over the paged JAX model.
+
+Mirrors the serving loop the reference drives through vLLM (SURVEY.md §3.1 'HOT LOOP:
+continuous batching on accelerator'), built XLA-first:
+
+- exactly two compiled programs after warmup — ``_prefill_fn`` (B=1, fixed chunk) and
+  ``_decode_fn`` (fixed slot batch, 1 token/slot) — both static-shaped; the host
+  scheduler packs work into them,
+- chunked prefill (agentic-serving's --max-num-batched-tokens analogue) so long prompts
+  never starve decode,
+- automatic prefix caching with chained block hashes + KV events (kv_manager),
+- preemption by recompute when pages run out (vLLM semantics),
+- P/D roles: ``role=prefill`` stops after prompt processing and exports KV metadata
+  (disagg connector picks it up); ``role=decode`` can import KV (disagg/transfer.py).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmd_tpu.core.kv_events import KVEvent
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine.config import EngineConfig
+from llmd_tpu.engine.kv_manager import PageAllocator, Sequence
+from llmd_tpu.engine.sampling import sample_tokens
+from llmd_tpu.models.config import ModelConfig
+from llmd_tpu.models.transformer import forward, init_cache, init_params, param_logical_axes
+from llmd_tpu.parallel.mesh import build_mesh
+
+
+@dataclass
+class EngineOutput:
+    request_id: str
+    new_token_ids: list[int]
+    finished: bool
+    finish_reason: Optional[str] = None
+    num_cached_prompt_tokens: int = 0
+    prompt_len: int = 0
+
+
+@dataclass
+class EngineStats:
+    num_waiting: int = 0
+    num_running: int = 0
+    kv_utilization: float = 0.0
+    total_prefill_tokens: int = 0
+    total_decode_tokens: int = 0
+    total_preemptions: int = 0
+
+
+class LLMEngine:
+    """Single-process engine instance (one model replica over one mesh)."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        params: Optional[dict[str, jax.Array]] = None,
+        event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
+        seed: int = 0,
+    ) -> None:
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg
+        self.mesh = build_mesh(engine_cfg.mesh) if engine_cfg.mesh.num_devices > 1 else None
+        self.alloc = PageAllocator(
+            engine_cfg.num_pages, engine_cfg.page_size,
+            enable_prefix_caching=engine_cfg.enable_prefix_caching,
+            event_sink=event_sink,
+        )
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
+        self.seqs: dict[str, Sequence] = {}
+        self.stats = EngineStats()
+        self._key = jax.random.PRNGKey(seed)
+        self._outputs: list[EngineOutput] = []
+
+        if params is None:
+            params = init_params(model_cfg, jax.random.PRNGKey(seed))
+        if self.mesh is not None:
+            from llmd_tpu.parallel.mesh import shard_pytree
+
+            params = shard_pytree(params, self.mesh, param_logical_axes(model_cfg))
+        self.params = params
+        self.cache = init_cache(model_cfg, engine_cfg.num_pages, engine_cfg.page_size)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self.cache = jax.device_put(
+                self.cache, NamedSharding(self.mesh, P(None, None, None, None, "tp", None))
+            )
+
+        cfg = model_cfg
+
+        def _prefill(params, cache, tokens, positions, page_table, kv_len):
+            logits, cache = forward(
+                cfg, params, cache, tokens[None], positions[None], page_table[None], kv_len[None]
+            )
+            return logits[0], cache
+
+        def _decode(params, cache, tokens, positions, page_tables, kv_lens):
+            logits, cache = forward(
+                cfg, params, cache, tokens[:, None], positions[:, None], page_tables, kv_lens
+            )
+            return logits[:, 0], cache
+
+        donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
+        self._prefill_fn = jax.jit(_prefill, **donate)
+        self._decode_fn = jax.jit(_decode, **donate)
+
+    # ------------------------------------------------------------------ API
+    def add_request(
+        self,
+        request_id: str,
+        token_ids: list[int],
+        sampling: Optional[SamplingParams] = None,
+        lora_id: Optional[str] = None,
+    ) -> None:
+        sampling = sampling or SamplingParams()
+        if not token_ids:
+            raise ValueError("empty prompt")
+        if len(token_ids) >= self.cfg.max_model_len:
+            token_ids = token_ids[: self.cfg.max_model_len - 1]
+        ps = self.cfg.page_size
+        if (len(token_ids) + 1 + ps - 1) // ps > self.cfg.num_pages:
+            raise ValueError(
+                f"prompt needs more KV pages than the whole pool "
+                f"({len(token_ids)} tokens, {self.cfg.num_pages} pages × {ps})"
+            )
+        seq = Sequence(
+            request_id=request_id, token_ids=list(token_ids), prompt_len=len(token_ids),
+            max_tokens=sampling.max_tokens, sampling=sampling, lora_id=lora_id,
+            arrival_time=time.monotonic(),
+        )
+        self.seqs[request_id] = seq
+        self.waiting.append(seq)
+
+    def abort(self, request_id: str) -> None:
+        seq = self.seqs.pop(request_id, None)
+        if seq is None:
+            return
+        if seq.slot >= 0:
+            self.running[seq.slot] = None
+        try:
+            self.waiting.remove(seq)
+        except ValueError:
+            pass
+        self._free_seq(seq)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.running)
+
+    # ------------------------------------------------------- scheduling core
+    def _free_seq(self, seq: Sequence) -> None:
+        for pid in seq.pages:
+            self.alloc.release(pid)
+        seq.pages = []
+
+    def _try_admit(self) -> None:
+        """Move waiting → running while slots + pages allow; reuse cached prefixes."""
+        while self.waiting:
+            try:
+                slot = self.running.index(None)
+            except ValueError:
+                return
+            seq = self.waiting[0]
+            ps = self.cfg.page_size
+            # prefix-cache lookup over complete prompt blocks
+            from llmd_tpu.core.kv_events import block_keys_for_tokens
+
+            keys = block_keys_for_tokens(seq.token_ids[: seq.prompt_len], ps, seq.lora_id)
+            hit_pages = self.alloc.match_prefix(keys) if self.cfg.enable_prefix_caching else []
+            # never reuse the whole prompt — the final token's logits must be computed
+            max_reuse = max(0, (seq.prompt_len - 1) // ps)
+            hit_pages = hit_pages[:max_reuse]
+
+            need_new = (min(seq.prompt_len + 1, self.cfg.max_pages_per_seq * ps) + ps - 1) // ps - len(hit_pages)
+            # acquire_cached pulls hit pages out of the evictable LRU, so they stop
+            # counting toward num_free — admission must budget num_free minus those
+            # pages or a request can consume the pool with its own hits and livelock.
+            hits_in_lru = sum(
+                1 for pid in hit_pages
+                if (info := self.alloc.pages.get(pid)) is not None and info.refs == 0
+            )
+            if need_new > self.cfg.num_pages:
+                # can never fit (prompt + generated tokens outgrew the pool, e.g. after
+                # a preemption late in generation): finish with length, don't starve
+                self.waiting.popleft()
+                seq.finished = True
+                seq.finish_reason = "length"
+                self.seqs.pop(seq.request_id, None)
+                self._outputs.append(EngineOutput(
+                    request_id=seq.request_id, new_token_ids=[], finished=True,
+                    finish_reason="length", prompt_len=seq.prompt_len,
+                ))
+                continue
+            if self.alloc.num_free - hits_in_lru < need_new:
+                return  # head-of-line blocks; FCFS admission
+            for pid in hit_pages:
+                self.alloc.acquire_cached(pid)
+            seq.pages = list(hit_pages)
+            seq.block_hashes = keys[: len(hit_pages)]
+            seq.num_computed = len(hit_pages) * ps
+            seq.num_cached_prompt = seq.num_computed
+            seq.slot = slot
+            self.running[slot] = seq
+            self.waiting.popleft()
+
+    def _ensure_pages(self, seq: Sequence, upto_tokens: int) -> bool:
+        ps = self.cfg.page_size
+        need = (upto_tokens + ps - 1) // ps
+        while len(seq.pages) < need:
+            pid = self.alloc.allocate()
+            if pid is None:
+                return False
+            seq.pages.append(pid)
+        return True
+
+    def _preempt_one(self) -> bool:
+        """Evict the most recently arrived running seq back to waiting (recompute)."""
+        victims = [s for s in self.running if s is not None]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.arrival_time)
+        self.running[victim.slot] = None
+        victim.slot = -1
+        self._free_seq(victim)
+        victim.num_computed = 0
+        victim.block_hashes = []
+        victim.num_cached_prompt = 0
+        self.waiting.appendleft(victim)
+        self.stats.total_preemptions += 1
+        return True
+
+    # --------------------------------------------------------------- stepping
+    def step(self) -> list[EngineOutput]:
+        """One engine iteration: admit → one prefill chunk (if any) → one decode batch."""
+        self._outputs = []
+        self._try_admit()
+        self._step_prefill()
+        self._step_decode()
+        self.stats.num_waiting = len(self.waiting)
+        self.stats.num_running = sum(1 for s in self.running if s is not None)
+        self.stats.kv_utilization = self.alloc.utilization()
+        return self._outputs
+
+    def _prefilling(self) -> Optional[Sequence]:
+        cands = [s for s in self.running if s is not None and s.num_computed < s.prompt_len]
+        return min(cands, key=lambda s: s.arrival_time) if cands else None
+
+    def _step_prefill(self) -> None:
+        seq = self._prefilling()
+        if seq is None:
+            return
+        ps = self.cfg.page_size
+        chunk = self.cfg.prefill_chunk
+        start = seq.num_computed
+        n = min(chunk, seq.prompt_len - start)
+        if not self._ensure_pages(seq, start + n):
+            if not self._preempt_one():
+                return
+            if seq.slot == -1 or not self._ensure_pages(seq, start + n):
+                return
+
+        toks = np.zeros((chunk,), np.int32)
+        toks[:n] = seq.token_ids[start : start + n]
+        pos = np.full((chunk,), -1, np.int32)
+        pos[:n] = np.arange(start, start + n)
+        pt = np.full((self.cfg.max_pages_per_seq,), -1, np.int32)
+        pt[: len(seq.pages)] = seq.pages
+
+        logits, self.cache = self._prefill_fn(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(pt), jnp.asarray(start + n, jnp.int32),
+        )
+        seq.num_computed = start + n
+        seq.maybe_commit_blocks(self.alloc)
+        self.stats.total_prefill_tokens += n
+
+        if seq.num_computed == seq.prompt_len:
+            # sample first token from the last prompt position's logits
+            self._sample_and_append([seq], logits[None, n - 1])
+
+    def _step_decode(self) -> None:
+        active = [
+            s for s in self.running
+            if s is not None and s.num_computed == len(s.token_ids) - 1 and s.num_computed >= s.prompt_len
+        ]
+        if not active:
+            return
+        B = self.cfg.max_batch_size
+        for s in list(active):
+            if s.slot < 0:
+                continue  # preempted by an earlier iteration of this loop
+            while not self._ensure_pages(s, len(s.token_ids)):
+                if not self._preempt_one() or s.slot < 0:
+                    break
+        active = [s for s in active if s.slot >= 0 and len(s.pages) * self.cfg.page_size >= len(s.token_ids)]
+        if not active:
+            return
+
+        toks = np.zeros((B,), np.int32)
+        pos = np.full((B,), -1, np.int32)
+        pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
+        lens = np.zeros((B,), np.int32)
+        for s in active:
+            i = s.slot
+            toks[i] = s.token_ids[-1]
+            pos[i] = len(s.token_ids) - 1
+            pts[i, : len(s.pages)] = s.pages
+            lens[i] = len(s.token_ids)
+
+        logits, self.cache = self._decode_fn(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(pts), jnp.asarray(lens),
+        )
+        for s in active:
+            s.num_computed = len(s.token_ids)
+            s.maybe_commit_blocks(self.alloc)
+        self.stats.total_decode_tokens += len(active)
+        self._sample_and_append(active, logits, slot_indexed=True)
+
+    def _sample_and_append(self, seqs: list[Sequence], logits: jax.Array, slot_indexed: bool = False) -> None:
+        B = logits.shape[0]
+        temp = np.zeros((B,), np.float32)
+        tk = np.zeros((B,), np.int32)
+        tp = np.ones((B,), np.float32)
+        rows = []
+        for j, s in enumerate(seqs):
+            i = s.slot if slot_indexed else j
+            rows.append(i)
+            sp: SamplingParams = s.sampling
+            temp[i] = sp.temperature
+            tk[i] = sp.top_k
+            tp[i] = sp.top_p
+        self._key, sub = jax.random.split(self._key)
+        sampled = np.asarray(
+            sample_tokens(logits.astype(jnp.float32), sub, jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
+        )
+        now = time.monotonic()
+        for s, i in zip(seqs, rows):
+            tok = int(sampled[i])
+            s.token_ids.append(tok)
+            if s.first_token_time is None:
+                s.first_token_time = now
+            finished, reason = self._check_finish(s, tok)
+            if finished:
+                s.finished = True
+                s.finish_reason = reason
+                self.running[s.slot] = None
+                s.slot = -1
+                self._free_seq(s)
+                self.seqs.pop(s.request_id, None)
+            self._outputs.append(EngineOutput(
+                request_id=s.request_id, new_token_ids=[tok], finished=finished,
+                finish_reason=reason, num_cached_prompt_tokens=s.num_cached_prompt,
+                prompt_len=s.prompt_len,
+            ))
+
+    def _check_finish(self, seq: Sequence, tok: int) -> tuple[bool, Optional[str]]:
+        sp: SamplingParams = seq.sampling
+        if not sp.ignore_eos and tok in (sp.stop_token_ids or ()):
+            return True, "stop"
+        if seq.num_generated >= seq.max_tokens:
+            return True, "length"
+        if len(seq.token_ids) >= self.cfg.max_model_len:
+            return True, "length"
+        return False, None
+
+    # ------------------------------------------------------------- convenience
+    def generate(self, prompts: list[list[int]], sampling: Optional[SamplingParams] = None) -> dict[str, list[int]]:
+        """Blocking batch generation (tests/bench); returns request_id → generated ids."""
+        for i, p in enumerate(prompts):
+            self.add_request(f"req-{i}", p, sampling)
+        done: dict[str, list[int]] = {f"req-{i}": [] for i in range(len(prompts))}
+        while self.has_work():
+            for out in self.step():
+                done[out.request_id].extend(out.new_token_ids)
+        return done

@@ -1,0 +1,65 @@
+"""Jitted batched sampler: greedy / temperature / top-k / top-p, static shapes.
+
+One program for the whole decode batch; per-slot parameters arrive as arrays so a mixed
+batch (greedy + sampled + different temperatures) is a single XLA launch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("top_k_max",))
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    key: jax.Array,
+    temperature: jax.Array,  # [B] (0 = greedy)
+    top_k: jax.Array,  # [B] int32 (0 = disabled)
+    top_p: jax.Array,  # [B] (1.0 = disabled)
+    top_k_max: int = 64,
+) -> jax.Array:
+    """Return sampled token ids [B].
+
+    top-k is bounded by static `top_k_max` (per-slot k masks within the top-k_max
+    candidates) to keep shapes static.
+    """
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k_max candidates once; per-slot k masking inside.
+    topv, topi = jax.lax.top_k(scaled, min(top_k_max, V))  # [B, K]
+    K = topv.shape[1]
+    ranks = jnp.arange(K)[None, :]
+    k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, K), K)[:, None]
+    topv = jnp.where(ranks < k_eff, topv, -jnp.inf)
+
+    # top-p on the (sorted) candidates
+    probs = jax.nn.softmax(topv, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]  # keep tokens until mass reached (incl. first)
+    topv = jnp.where(keep, topv, -jnp.inf)
+
+    choice = jax.random.categorical(key, topv, axis=-1)  # [B] index into candidates
+    sampled = jnp.take_along_axis(topi, choice[:, None], axis=1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V]
+    output_mask: jax.Array,  # [B, V] bool: token appeared in output
+    presence: jax.Array,  # [B]
+    frequency_counts: jax.Array,  # [B, V] float
+    frequency: jax.Array,  # [B]
+    repetition: jax.Array,  # [B] (1.0 = off)
+) -> jax.Array:
+    logits = logits - presence[:, None] * output_mask
+    logits = logits - frequency[:, None] * frequency_counts
+    rep = repetition[:, None]
+    penalized = jnp.where(logits > 0, logits / rep, logits * rep)
+    return jnp.where(output_mask, penalized, logits)

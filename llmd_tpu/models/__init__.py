@@ -1,0 +1,10 @@
+"""Model families: functional JAX decoder-only transformers (dense + MoE).
+
+Covers the architectures the reference serves through vLLM (Llama/Qwen dense,
+Qwen/DeepSeek MoE — guides/* model lists) with one configurable stack: RoPE, GQA,
+RMSNorm, SwiGLU, optional top-k routed MoE with shared experts. Weights are stacked
+[L, ...] and the stack runs under lax.scan so compile time is depth-independent.
+"""
+
+from llmd_tpu.models.config import ModelConfig  # noqa: F401
+from llmd_tpu.models.registry import get_model_config, MODEL_REGISTRY  # noqa: F401

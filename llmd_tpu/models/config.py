@@ -1,0 +1,42 @@
+"""Model architecture config."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    name: str = "tiny"
+    vocab_size: int = 288  # byte-level tokenizer (256 bytes + specials), padded to tile
+    hidden_size: int = 128
+    intermediate_size: int = 384
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: int = 32
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    max_position: int = 32768
+    tie_embeddings: bool = True
+    dtype: str = "bfloat16"
+    # MoE (0 experts = dense). All layers share the same shape so the stack scans.
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
+    moe_intermediate_size: int = 0
+    moe_num_shared_experts: int = 0
+    moe_capacity_factor: float = 1.25
+
+    @property
+    def jax_dtype(self):
+        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32}[self.dtype]
+
+    @property
+    def is_moe(self) -> bool:
+        return self.moe_num_experts > 0
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads

@@ -1,0 +1,58 @@
+"""Named model configs: test-size + flagship serving shapes.
+
+The reference's guides serve Qwen3-32B (optimized-baseline), Llama-3-70B / gpt-oss-120b
+(pd-disaggregation), DeepSeek-R1 (wide-ep-lws) via vLLM; here each family maps to a
+config of our stack. Sizes marked `-sim` are scaled to fit the available chip while
+keeping the architectural shape (GQA ratios, MoE top-k) of the original.
+"""
+
+from __future__ import annotations
+
+from llmd_tpu.models.config import ModelConfig
+
+MODEL_REGISTRY: dict[str, ModelConfig] = {
+    # CI-size models (CPU-runnable, byte-level vocab)
+    "tiny": ModelConfig(
+        name="tiny", vocab_size=288, hidden_size=128, intermediate_size=384,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+    ),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", vocab_size=288, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+        moe_num_experts=8, moe_top_k=2, moe_intermediate_size=128,
+        moe_num_shared_experts=1,
+    ),
+    # Flagship single-chip bench model (~1.1B params bf16 ≈ 2.2GB — fits v5e 16GB HBM
+    # with room for KV pages). Llama-3.2-1B-shaped.
+    "llama-1b": ModelConfig(
+        name="llama-1b", vocab_size=32768, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+        rope_theta=500000.0, tie_embeddings=True,
+    ),
+    # Llama-3-8B shape (multi-chip TP target).
+    "llama-8b": ModelConfig(
+        name="llama-8b", vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0, tie_embeddings=False,
+    ),
+    # Qwen3-32B shape (optimized-baseline parity target).
+    "qwen-32b": ModelConfig(
+        name="qwen-32b", vocab_size=151936, hidden_size=5120, intermediate_size=25600,
+        num_layers=64, num_heads=64, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, tie_embeddings=False,
+    ),
+    # DeepSeek-R1-class MoE shape scaled for wide-EP dry-runs (shape, not size).
+    "moe-wide-sim": ModelConfig(
+        name="moe-wide-sim", vocab_size=32768, hidden_size=1024, intermediate_size=2048,
+        num_layers=4, num_heads=16, num_kv_heads=4, head_dim=64,
+        moe_num_experts=32, moe_top_k=4, moe_intermediate_size=512,
+        moe_num_shared_experts=1,
+    ),
+}
+
+
+def get_model_config(name: str) -> ModelConfig:
+    try:
+        return MODEL_REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(MODEL_REGISTRY)}") from None

@@ -1,0 +1,303 @@
+"""Functional decoder-only transformer over a paged KV cache.
+
+TPU-first design choices:
+- Weights stacked ``[num_layers, ...]`` and the layer stack runs under ``lax.scan`` —
+  one trace/compile regardless of depth, XLA pipelines the layers.
+- All shapes static: chunked prefill processes fixed-size chunks, decode processes a
+  fixed slot batch; page tables are fixed-width. No data-dependent control flow.
+- bfloat16 everywhere on the matmul path (MXU); fp32 for softmax/rmsnorm accumulation.
+- Sharding via logical axis names bound by ``llmd_tpu.parallel.mesh.ShardingRules``:
+  heads/mlp → tp, experts → ep, batch → dp (GSPMD inserts the collectives).
+
+Engine-parity note: this plays the role of vLLM's model runner on the reference's TPU
+path (vllm `tpu_inference` plugin, docker/common-versions:5-6); attention is the
+reference-semantics paged attention; the Pallas fused kernel lives in
+``llmd_tpu.ops.paged_attention`` and is swapped in by the runner on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from llmd_tpu.models.config import ModelConfig
+
+# ---------------------------------------------------------------------------
+# Parameter init + logical sharding axes
+# ---------------------------------------------------------------------------
+
+
+def param_logical_axes(cfg: ModelConfig) -> dict[str, Any]:
+    """Logical axis names per parameter leaf (None entry = replicated axis)."""
+    axes: dict[str, Any] = {
+        "embed": ("vocab", "embed"),
+        "final_norm": ("embed",),
+        # stacked per-layer leaves carry a leading 'layers' axis
+        "attn_norm": ("layers", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+    }
+    if cfg.is_moe:
+        axes |= {
+            "router": ("layers", "embed", "experts"),
+            "moe_wi": ("layers", "experts", "embed", "expert_mlp"),
+            "moe_wo": ("layers", "experts", "expert_mlp", "embed"),
+        }
+        if cfg.moe_num_shared_experts:
+            axes |= {
+                "shared_wi": ("layers", "embed", "mlp"),
+                "shared_wo": ("layers", "mlp", "embed"),
+            }
+    else:
+        axes |= {"wi": ("layers", "embed", "mlp"), "wo_mlp": ("layers", "mlp", "embed")}
+    if not cfg.tie_embeddings:
+        axes["unembed"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict[str, jax.Array]:
+    """Random-init params (scaled normal); shapes match param_logical_axes."""
+    dt = cfg.jax_dtype
+    L, D, H, Hk, Dh = cfg.num_layers, cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    F = cfg.intermediate_size
+    keys = iter(jax.random.split(key, 20))
+
+    def norm(shape, scale):
+        return (jax.random.normal(next(keys), shape, jnp.float32) * scale).astype(dt)
+
+    s = D ** -0.5
+    p: dict[str, jax.Array] = {
+        "embed": norm((cfg.vocab_size, D), 0.02),
+        "final_norm": jnp.ones((D,), dt),
+        "attn_norm": jnp.ones((L, D), dt),
+        "mlp_norm": jnp.ones((L, D), dt),
+        "wq": norm((L, D, H, Dh), s),
+        "wk": norm((L, D, Hk, Dh), s),
+        "wv": norm((L, D, Hk, Dh), s),
+        "wo": norm((L, H, Dh, D), (H * Dh) ** -0.5),
+    }
+    if cfg.is_moe:
+        E, Fe = cfg.moe_num_experts, cfg.moe_intermediate_size or F
+        p["router"] = norm((L, D, E), s)
+        p["moe_wi"] = norm((L, E, D, 2 * Fe), s)
+        p["moe_wo"] = norm((L, E, Fe, D), Fe ** -0.5)
+        if cfg.moe_num_shared_experts:
+            Fs = F * cfg.moe_num_shared_experts
+            p["shared_wi"] = norm((L, D, 2 * Fs), s)
+            p["shared_wo"] = norm((L, Fs, D), Fs ** -0.5)
+    else:
+        p["wi"] = norm((L, D, 2 * F), s)  # fused gate+up (SwiGLU)
+        p["wo_mlp"] = norm((L, F, D), F ** -0.5)
+    if not cfg.tie_embeddings:
+        p["unembed"] = norm((D, cfg.vocab_size), s)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [..., T, H, Dh]; positions: [..., T]."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [..., T, half]
+    cos, sin = jnp.cos(angles)[..., None, :], jnp.sin(angles)[..., None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, wi: jax.Array, wo: jax.Array) -> jax.Array:
+    gate_up = jnp.einsum("...d,df->...f", x, wi)
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    return jnp.einsum("...f,fd->...d", jax.nn.silu(gate) * up, wo)
+
+
+def moe_block(cfg: ModelConfig, x: jax.Array, router, wi, wo) -> jax.Array:
+    """Top-k routed MoE with capacity-based dispatch (XLA-friendly static shapes).
+
+    x: [T, D]. Expert dim is sharded over the `ep` mesh axis; the dispatch/combine
+    einsums lower to all-to-all when tokens are dp/sp-sharded — the XLA-native stand-in
+    for DeepEP's NVSHMEM all-to-all (reference wide-ep decode.yaml:87-121). A Pallas
+    ragged all-to-all variant can replace it without touching callers.
+    """
+    T, D = x.shape
+    E, k = cfg.moe_num_experts, cfg.moe_top_k
+    C = max(1, int(T * k / E * cfg.moe_capacity_factor))
+
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router.astype(jnp.float32))
+    weights = jax.nn.softmax(logits, axis=-1)
+    topw, topi = lax.top_k(weights, k)  # [T, k]
+    topw = topw / (jnp.sum(topw, axis=-1, keepdims=True) + 1e-9)
+
+    # position of each (token, slot) within its expert's capacity buffer
+    onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)  # [T, k, E]
+    flat = onehot.reshape(T * k, E)
+    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(T, k, E)
+    keep = (pos_in_expert < C).astype(x.dtype) * onehot.astype(x.dtype)
+    # dispatch tensor [T, k, E, C]
+    disp = keep[..., None] * jax.nn.one_hot(pos_in_expert, C, dtype=x.dtype)
+    comb = disp * topw[..., None, None].astype(x.dtype)
+    disp2 = disp.sum(1)  # [T, E, C]
+    comb2 = comb.sum(1)
+
+    xe = jnp.einsum("tec,td->ecd", disp2, x)  # all-to-all in, [E, C, D]
+    gate_up = jnp.einsum("ecd,edf->ecf", xe, wi)
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, wo)
+    return jnp.einsum("tec,ecd->td", comb2, ye)  # all-to-all back
+
+
+# ---------------------------------------------------------------------------
+# Paged attention (reference semantics; Pallas kernel swapped in by the runner)
+# ---------------------------------------------------------------------------
+
+
+class PagedKVLayout(NamedTuple):
+    """cache: [L, 2, num_pages, page_size, kv_heads, head_dim] (k=0, v=1)."""
+
+    num_pages: int
+    page_size: int
+
+
+def write_kv(layer_cache: jax.Array, k: jax.Array, v: jax.Array, slots: jax.Array) -> jax.Array:
+    """Write new tokens' K/V into flat page slots.
+
+    layer_cache: [2, P, ps, Hk, Dh]; k/v: [T, Hk, Dh]; slots: [T] global slot ids
+    (page_id * page_size + offset). Slot -1 marks padding (dropped via clamp+where).
+    """
+    two, Pn, ps, Hk, Dh = layer_cache.shape
+    flat = layer_cache.reshape(2, Pn * ps, Hk, Dh)
+    # Padding tokens (slot -1) are routed out of bounds and dropped by the scatter —
+    # never remap them to a real slot: a duplicate index with a real write has
+    # undefined winner ordering.
+    idx = jnp.where(slots >= 0, slots, Pn * ps)
+    kv = jnp.stack([k, v]).astype(flat.dtype)  # [2, T, Hk, Dh]
+    flat = flat.at[:, idx].set(kv, mode="drop")
+    return flat.reshape(2, Pn, ps, Hk, Dh)
+
+
+def paged_attention(
+    q: jax.Array,  # [B, T, H, Dh]
+    layer_cache: jax.Array,  # [2, P, ps, Hk, Dh]
+    page_tables: jax.Array,  # [B, max_pages]
+    q_positions: jax.Array,  # [B, T] global positions of queries (-1 pad)
+    kv_lens: jax.Array,  # [B] total tokens in cache per seq (incl. new)
+) -> jax.Array:
+    """Reference-semantics ragged paged attention (gather + mask).
+
+    Every query attends to its sequence's cache slots with causal masking by global
+    position. Static shapes: S = max_pages * page_size keys are gathered and masked.
+    """
+    B, T, H, Dh = q.shape
+    _, Pn, ps, Hk, _ = layer_cache.shape
+    S = page_tables.shape[1] * ps
+    kc, vc = layer_cache[0], layer_cache[1]
+    safe_pages = jnp.where(page_tables >= 0, page_tables, 0)
+    k = kc[safe_pages].reshape(B, S, Hk, Dh)  # [B, S, Hk, Dh]
+    v = vc[safe_pages].reshape(B, S, Hk, Dh)
+
+    qpk = H // Hk
+    qg = q.reshape(B, T, Hk, qpk, Dh)
+    scores = jnp.einsum("bthqd,bshd->bhqts", qg.astype(jnp.float32), k.astype(jnp.float32))
+    scores *= Dh ** -0.5
+
+    key_pos = jnp.arange(S)[None, :]  # [1, S]
+    valid_key = key_pos < kv_lens[:, None]  # [B, S]
+    causal = key_pos[:, None, :] <= q_positions[..., None]  # [B, T, S]
+    mask = (valid_key[:, None, :] & causal & (q_positions[..., None] >= 0))  # [B, T, S]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqts,bshd->bthqd", probs.astype(v.dtype), v)
+    return out.reshape(B, T, H, Dh)
+
+
+# ---------------------------------------------------------------------------
+# Full forward over the scanned layer stack
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    cfg: ModelConfig,
+    params: dict[str, jax.Array],
+    cache: jax.Array,  # [L, 2, P, ps, Hk, Dh]
+    tokens: jax.Array,  # [B, T]
+    positions: jax.Array,  # [B, T] (-1 pad)
+    page_tables: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B] cache length AFTER this step's tokens
+    attn_impl=paged_attention,
+) -> tuple[jax.Array, jax.Array]:
+    """Run tokens through the model, writing K/V into the paged cache.
+
+    Serves both chunked prefill (T = chunk) and decode (T = 1): the engine packs
+    whatever fits. Returns (logits [B, T, vocab], updated cache).
+    """
+    B, T = tokens.shape
+    ps = cache.shape[3]
+    x = params["embed"][tokens].astype(cfg.jax_dtype)  # [B, T, D]
+
+    # global slot ids for the new tokens: page_table[pos // ps] * ps + pos % ps
+    pidx = jnp.where(positions >= 0, positions, 0) // ps
+    safe_page = jnp.take_along_axis(jnp.where(page_tables >= 0, page_tables, 0), pidx, axis=1)
+    slots = jnp.where(positions >= 0, safe_page * ps + positions % ps, -1)  # [B, T]
+    flat_slots = slots.reshape(B * T)
+
+    stacked_keys = ("attn_norm", "mlp_norm", "wq", "wk", "wv", "wo") + (
+        ("router", "moe_wi", "moe_wo") + (("shared_wi", "shared_wo") if cfg.moe_num_shared_experts else ())
+        if cfg.is_moe
+        else ("wi", "wo_mlp")
+    )
+    layer_params = {k: params[k] for k in stacked_keys}
+
+    def body(carry, scanned):
+        x, _ = carry
+        lp, cache_l = scanned  # per-layer params + this layer's cache [2, P, ps, Hk, Dh]
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
+        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"])
+        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"])
+        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"])
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+        cache_l = write_kv(cache_l, k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
+                           v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim), flat_slots)
+        attn = attn_impl(q, cache_l, page_tables, positions, kv_lens)
+        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
+        if cfg.is_moe:
+            y = moe_block(cfg, h.reshape(B * T, -1), lp["router"], lp["moe_wi"], lp["moe_wo"])
+            y = y.reshape(B, T, -1)
+            if cfg.moe_num_shared_experts:
+                y = y + swiglu(h, lp["shared_wi"], lp["shared_wo"])
+        else:
+            y = swiglu(h, lp["wi"], lp["wo_mlp"])
+        x = x + y
+        return (x, 0), cache_l
+
+    (x, _), new_cache = lax.scan(body, (x, 0), (layer_params, cache))
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), unembed.astype(jnp.float32))
+    return logits, new_cache
+
+
+def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> jax.Array:
+    return jnp.zeros(
+        (cfg.num_layers, 2, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim),
+        cfg.jax_dtype,
+    )

@@ -1,0 +1,13 @@
+"""Mesh/sharding layer: TP over ICI, DP across replicas/slices, EP for MoE, SP for
+long context. The TPU-native answer to the reference's NCCL/NVSHMEM/MPI stack
+(SURVEY.md §5 'Distributed communication backend'): XLA collectives inserted by GSPMD
+from sharding annotations, shard_map for explicit all-to-all in the MoE path.
+"""
+
+from llmd_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    shard_pytree,
+    ShardingRules,
+    DEFAULT_RULES,
+)

@@ -1,0 +1,87 @@
+"""Device mesh construction + logical-axis sharding rules.
+
+Replaces the reference's launcher-driven parallelism flags
+(--tensor-parallel-size / --data-parallel-size / --enable-expert-parallel,
+wide-ep-lws decode.yaml:85-121) with a declarative mesh:
+
+- ``tp``  — tensor parallel over ICI (MXU-feeding matmul shards)
+- ``ep``  — expert parallel for MoE (all-to-all over ICI)
+- ``dp``  — data parallel across replicas/slices (DCN or ICI)
+- ``sp``  — sequence parallel for long-context prefill (ring over ICI)
+
+GSPMD inserts psum/all-gather/reduce-scatter/all-to-all from these annotations — no
+hand-written NCCL calls anywhere (scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    ep: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.ep * self.tp * self.sp
+
+    def axis_names(self) -> tuple[str, ...]:
+        return ("dp", "sp", "ep", "tp")
+
+
+def build_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh with axes (dp, sp, ep, tp); tp innermost so it rides the
+    fastest ICI links, dp outermost so it can span DCN (cross-slice)."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = cfg.num_devices
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices for {cfg}, have {len(devs)}")
+    arr = np.array(devs[:n]).reshape(cfg.dp, cfg.sp, cfg.ep, cfg.tp)
+    return Mesh(arr, cfg.axis_names())
+
+
+# Logical axis name → mesh axis (None = replicated). The model annotates params and
+# activations with logical names; these rules bind them to the physical mesh.
+@dataclass(frozen=True)
+class ShardingRules:
+    rules: tuple[tuple[str, Optional[str]], ...] = ()
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> P:
+        m = dict(self.rules)
+        return P(*[m.get(a) if a is not None else None for a in logical_axes])
+
+    def sharding(self, mesh: Mesh, logical_axes: Sequence[Optional[str]]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes))
+
+
+DEFAULT_RULES = ShardingRules(rules=(
+    ("batch", "dp"),
+    ("sequence", "sp"),          # sequence-parallel long-context prefill
+    ("vocab", "tp"),
+    ("embed", None),             # hidden dim replicated (activations)
+    ("heads", "tp"),             # attention heads → tp (Megatron-style column parallel)
+    ("kv_heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),               # ffn intermediate → tp
+    ("experts", "ep"),           # MoE expert dim → ep
+    ("expert_mlp", "tp"),        # within-expert ffn → tp
+    ("kv_pages", None),
+    ("layers", None),
+))
+
+
+def shard_pytree(tree, mesh: Mesh, axes_tree, rules: ShardingRules = DEFAULT_RULES):
+    """device_put every leaf with the NamedSharding derived from its logical axes."""
+    def _put(x, axes):
+        return jax.device_put(x, rules.sharding(mesh, axes))
+    return jax.tree.map(_put, tree, axes_tree, is_leaf=lambda x: x is None)

@@ -8,10 +8,21 @@ Must run before the first `import jax` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# This image injects a TPU-tunnel PJRT plugin ("axon") via sitecustomize that
+# monkeypatches xla_bridge and force-initializes the (single-session, slow) TPU client
+# even when JAX_PLATFORMS=cpu. Deregister its factory and pin the platform config so
+# tests run on the 8-device virtual CPU mesh.
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 

@@ -1,0 +1,145 @@
+"""Engine tests: continuous batching, prefix caching, preemption, determinism."""
+
+import jax
+import numpy as np
+import pytest
+
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine import EngineConfig, LLMEngine
+from llmd_tpu.models import get_model_config
+
+
+@pytest.fixture(scope="module")
+def engine_factory():
+    cfg = get_model_config("tiny")
+
+    def make(event_sink=None, **kw):
+        defaults = dict(page_size=8, num_pages=64, max_model_len=256,
+                       max_batch_size=4, prefill_chunk=32)
+        defaults.update(kw)
+        return LLMEngine(cfg, EngineConfig(**defaults), event_sink=event_sink)
+
+    return make
+
+
+def test_single_request_greedy(engine_factory):
+    eng = engine_factory()
+    prompt = list(range(10, 30))
+    out = eng.generate([prompt], SamplingParams(max_tokens=8, temperature=0.0))
+    assert len(out["req-0"]) == 8
+    # deterministic greedy: regenerate gives same ids
+    eng2 = engine_factory()
+    out2 = eng2.generate([prompt], SamplingParams(max_tokens=8, temperature=0.0))
+    assert out["req-0"] == out2["req-0"]
+
+
+def test_decode_matches_unchunked_prefill(engine_factory):
+    """Chunked prefill + decode must produce the same ids as a one-shot run."""
+    prompt = list(range(5, 70))  # crosses multiple chunks with chunk=32
+    big = engine_factory(prefill_chunk=128)
+    small = engine_factory(prefill_chunk=16)
+    o1 = big.generate([prompt], SamplingParams(max_tokens=6, temperature=0.0))
+    o2 = small.generate([prompt], SamplingParams(max_tokens=6, temperature=0.0))
+    assert o1["req-0"] == o2["req-0"]
+
+
+def test_batch_equivalence(engine_factory):
+    """Sequences generated concurrently must match solo greedy runs."""
+    prompts = [list(range(3, 20)), list(range(40, 80)), list(range(100, 110))]
+    eng = engine_factory()
+    batch_out = eng.generate(prompts, SamplingParams(max_tokens=5, temperature=0.0))
+    for i, p in enumerate(prompts):
+        solo = engine_factory().generate([p], SamplingParams(max_tokens=5, temperature=0.0))
+        assert batch_out[f"req-{i}"] == solo["req-0"], f"seq {i} diverged in batch"
+
+
+def test_prefix_cache_reuse(engine_factory):
+    events = []
+    eng = engine_factory(event_sink=lambda evs: events.extend(evs))
+    shared = list(range(1, 65))  # 8 full pages of 8
+    eng.generate([shared + [70, 71]], SamplingParams(max_tokens=2, temperature=0.0))
+    n_stored = len(events)
+    assert n_stored > 0
+
+    # Second request with same prefix: must reuse cached pages
+    eng.add_request("r2", shared + [90, 91], SamplingParams(max_tokens=2, temperature=0.0))
+    while eng.has_work():
+        outs = eng.step()
+    seq_cached = [o for o in outs if o.request_id == "r2"] or None
+    # check via stats: the request reported cached prompt tokens
+    done = [o for o in events if True]
+    assert eng.stats.total_prefill_tokens < 2 * 66 + 2  # second prompt mostly skipped
+
+
+def test_prefix_cache_correctness(engine_factory):
+    """Cached-prefix path must yield identical tokens to cold path."""
+    shared = list(range(1, 65))
+    eng = engine_factory()
+    cold = eng.generate([shared + [70]], SamplingParams(max_tokens=6, temperature=0.0))
+    # warm run through the same engine (prefix now cached)
+    eng.add_request("warm", shared + [70], SamplingParams(max_tokens=6, temperature=0.0))
+    got: list[int] = []
+    while eng.has_work():
+        for o in eng.step():
+            if o.request_id == "warm":
+                got.extend(o.new_token_ids)
+    assert got == cold["req-0"]
+    warm_seq_cached = 64 - 8  # full blocks minus nothing; at least some reuse happened
+    assert eng.stats.total_prefill_tokens < 2 * 65
+
+
+def test_preemption_under_page_pressure(engine_factory):
+    """More concurrent work than pages: engine must preempt and still finish all."""
+    eng = engine_factory(num_pages=16, max_batch_size=4, enable_prefix_caching=False)
+    prompts = [list(range(i * 7 + 1, i * 7 + 40)) for i in range(4)]
+    out = eng.generate(prompts, SamplingParams(max_tokens=12, temperature=0.0))
+    for i in range(4):
+        assert len(out[f"req-{i}"]) == 12
+    assert eng.stats.total_preemptions >= 0  # must not deadlock (finishing is the test)
+
+
+def test_sampling_temperature_seeded(engine_factory):
+    eng = engine_factory()
+    prompt = list(range(10, 40))
+    out = eng.generate([prompt] * 2, SamplingParams(max_tokens=10, temperature=1.0, top_k=20))
+    # sampled outputs exist and respect max_tokens
+    assert len(out["req-0"]) == 10 and len(out["req-1"]) == 10
+
+
+def test_stop_token(engine_factory):
+    eng = engine_factory()
+    prompt = list(range(10, 30))
+    # First greedy token becomes the stop token of a second run
+    first = eng.generate([prompt], SamplingParams(max_tokens=4, temperature=0.0))["req-0"][0]
+    eng2 = engine_factory()
+    out = eng2.generate([prompt], SamplingParams(max_tokens=4, temperature=0.0, stop_token_ids=[first]))
+    assert out["req-0"] == [first]  # stopped immediately with reason=stop
+
+
+def test_oversized_prompt_rejected(engine_factory):
+    eng = engine_factory(num_pages=4)  # pool = 32 tokens
+    with pytest.raises(ValueError):
+        eng.add_request("big", list(range(100)), SamplingParams(max_tokens=4))
+    with pytest.raises(ValueError):
+        eng.add_request("empty", [], SamplingParams())
+
+
+def test_duplicate_prefix_concurrent(engine_factory):
+    """Two identical prompts in flight concurrently must not corrupt the allocator."""
+    eng = engine_factory()
+    p = list(range(1, 50))
+    out = eng.generate([p, p, p], SamplingParams(max_tokens=6, temperature=0.0))
+    assert out["req-0"] == out["req-1"] == out["req-2"]
+    # allocator invariant: every cached hash maps to a live page with that hash
+    for h, pid in eng.alloc.cached.items():
+        assert eng.alloc.pages[pid].block_hash == h
+
+
+def test_full_pool_prefix_reuse_no_livelock(engine_factory):
+    """Request whose prefix hits fill the whole pool must not self-preempt forever."""
+    eng = engine_factory(num_pages=9, max_batch_size=2)
+    base = list(range(1, 64))  # ~8 pages
+    eng.generate([base + [70]], SamplingParams(max_tokens=2, temperature=0.0))
+    # longer follow-up sharing the prefix; pool is tight but feasible
+    out = eng.generate([base + [70, 71, 72]], SamplingParams(max_tokens=2, temperature=0.0))
+    assert len(out["req-0"]) == 2
