@@ -1,0 +1,89 @@
+"""Serving throughput benchmark on the local chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures steady-state output token throughput (the reference's headline unit — output
+tok/s, e.g. BASELINE.md rows 5/7/13) of the flagship single-chip model (llama-1b,
+random weights) under continuous batching: 32 concurrent requests, ISL 256 / OSL 128,
+greedy, multi-step fused decode.
+
+vs_baseline anchors to BASELINE.md row 5: ~3,100 output tok/s per decode GPU
+(16x16 B200 wide-EP) — the reference's per-accelerator decode throughput headline.
+A v5e chip has ~1/20 the FLOPs/HBM-BW of a B200, so >0.1 here already means the
+serving stack itself (batching, paging, fused decode) is not the bottleneck.
+
+Usage: python bench.py [--tiny] [--cpu]   (flags for CI-sized smoke runs)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> None:
+    tiny = "--tiny" in sys.argv
+    if "--cpu" in sys.argv:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from llmd_tpu.core.request import SamplingParams
+    from llmd_tpu.engine import EngineConfig, LLMEngine
+    from llmd_tpu.models import get_model_config
+
+    if tiny:
+        model, n_req, isl, osl = "tiny", 8, 64, 32
+        eng_cfg = EngineConfig(page_size=16, num_pages=256, max_model_len=512,
+                               max_batch_size=8, prefill_chunk=64, decode_steps=8)
+    else:
+        model, n_req, isl, osl = "llama-1b", 32, 256, 128
+        eng_cfg = EngineConfig(page_size=16, num_pages=2048, max_model_len=1024,
+                               max_batch_size=32, prefill_chunk=256, decode_steps=16)
+
+    cfg = get_model_config(model)
+    t0 = time.monotonic()
+    eng = LLMEngine(cfg, eng_cfg)
+    print(f"# engine built in {time.monotonic() - t0:.1f}s on {jax.devices()[0]}", file=sys.stderr)
+
+    sp = SamplingParams(max_tokens=osl, temperature=0.0, ignore_eos=True)
+
+    def prompts(n: int, salt: int):
+        # distinct prompts (no prefix-cache shortcut): salt offsets the token stream
+        return [[(salt * 7919 + i * 131 + j) % (cfg.vocab_size - 2) + 1 for j in range(isl)]
+                for i in range(n)]
+
+    # Warmup: compile prefill + fused decode (and exercise the allocator)
+    t0 = time.monotonic()
+    eng.generate(prompts(2, salt=1), SamplingParams(max_tokens=osl, temperature=0.0, ignore_eos=True))
+    print(f"# warmup/compile {time.monotonic() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.monotonic()
+    out = eng.generate(prompts(n_req, salt=2), sp)
+    wall = time.monotonic() - t0
+    out_tokens = sum(len(v) for v in out.values())
+    assert out_tokens == n_req * osl, (out_tokens, n_req * osl)
+    tput = out_tokens / wall
+    print(f"# {out_tokens} output tokens in {wall:.2f}s "
+          f"(prefill {eng.stats.total_prefill_tokens} toks, "
+          f"decode {eng.stats.total_decode_tokens} toks, "
+          f"preemptions {eng.stats.total_preemptions})", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "output_tok_per_s_per_chip",
+        "value": round(tput, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tput / 3100.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Async facade over LLMEngine: a dedicated step-loop thread feeding asyncio streams.
+
+JAX dispatch blocks the calling thread, so the engine loop lives off the event loop;
+request submission and token delivery cross the boundary through thread-safe queues —
+the same split the reference's engines use (API server process ↔ engine core).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import AsyncIterator, Optional
+
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine.engine import EngineOutput, LLMEngine
+
+
+class AsyncLLMEngine:
+    def __init__(self, engine: LLMEngine, idle_sleep_s: float = 0.002) -> None:
+        self.engine = engine
+        self._idle_sleep = idle_sleep_s
+        self._lock = threading.Lock()
+        self._streams: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="engine-loop", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._lock:
+                has_work = self.engine.has_work()
+                outputs = self.engine.step() if has_work else []
+            for out in outputs:
+                entry = self._streams.get(out.request_id)
+                if entry is None:
+                    continue
+                loop, q = entry
+                loop.call_soon_threadsafe(q.put_nowait, out)
+                if out.finished:
+                    self._streams.pop(out.request_id, None)
+            if not has_work:
+                time.sleep(self._idle_sleep)
+
+    # -- API ---------------------------------------------------------------
+    async def generate(
+        self,
+        request_id: str,
+        token_ids: list[int],
+        sampling: SamplingParams,
+        lora_id: Optional[str] = None,
+    ) -> AsyncIterator[EngineOutput]:
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[request_id] = (loop, q)
+        try:
+            with self._lock:
+                self.engine.add_request(request_id, token_ids, sampling, lora_id)
+        except ValueError:
+            self._streams.pop(request_id, None)
+            raise
+        try:
+            while True:
+                out: EngineOutput = await q.get()
+                yield out
+                if out.finished:
+                    return
+        finally:
+            self._streams.pop(request_id, None)
+            if request_id in self.engine.seqs:
+                with self._lock:
+                    self.engine.abort(request_id)
+
+    def stats(self):
+        return self.engine.stats

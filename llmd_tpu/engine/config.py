@@ -22,6 +22,9 @@ class EngineConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     # Scheduling
     max_queue: int = 1024
+    # Multi-step decode: run N decode iterations in one on-device lax.scan (one host
+    # round-trip per N tokens). Stop/max_tokens handled post-hoc by truncation.
+    decode_steps: int = 1
     # KV offload tier (pages of CPU-side cache; 0 = disabled) — K3 equivalent.
     cpu_offload_pages: int = 0
     # P/D role (disaggregation/README.md roles kv_producer/kv_consumer/both)

@@ -97,22 +97,67 @@ class LLMEngine:
             )
 
         cfg = model_cfg
+        mesh = self.mesh
+
+        def _bind(x, *axes):
+            """GSPMD sharding constraint by mesh axis names (no-op off-mesh)."""
+            if mesh is None:
+                return x
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))
 
         def _prefill(params, cache, tokens, positions, page_table, kv_len):
+            # sequence-parallel long-context prefill: chunk dim sharded over sp
+            tokens = _bind(tokens, "sp")
+            positions = _bind(positions, "sp")
             logits, cache = forward(
                 cfg, params, cache, tokens[None], positions[None], page_table[None], kv_len[None]
             )
             return logits[0], cache
 
         def _decode(params, cache, tokens, positions, page_tables, kv_lens):
+            # decode batch sharded over dp; heads/experts sharding rides on params
+            tokens = _bind(tokens, "dp")
+            positions = _bind(positions, "dp")
+            page_tables = _bind(page_tables, "dp", None)
+            kv_lens = _bind(kv_lens, "dp")
             logits, cache = forward(
                 cfg, params, cache, tokens[:, None], positions[:, None], page_tables, kv_lens
             )
             return logits[:, 0], cache
 
+        def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
+                          temp, top_k, top_p, key, active_mask):
+            """k decode iterations fused on-device (lax.scan): feed sampled token back
+            each step; one host round-trip per k tokens instead of per token."""
+            tokens = _bind(tokens, "dp")
+            positions = _bind(positions, "dp")
+            page_tables = _bind(page_tables, "dp", None)
+            kv_lens = _bind(kv_lens, "dp")
+
+            def body(carry, _):
+                cache, toks, pos, lens, key = carry
+                logits, cache = forward(
+                    cfg, params, cache, toks[:, None], pos[:, None], page_tables, lens
+                )
+                key, sub = jax.random.split(key)
+                nxt = sample_tokens(logits[:, 0].astype(jnp.float32), sub, temp, top_k, top_p)
+                nxt = jnp.where(active_mask, nxt, 0)
+                pos = jnp.where(active_mask, pos + 1, pos)
+                lens = jnp.where(active_mask, lens + 1, lens)
+                return (cache, nxt, pos, lens, key), nxt
+
+            (cache, _, _, _, _), toks_out = jax.lax.scan(
+                body, (cache, tokens, positions, kv_lens, key), None,
+                length=engine_cfg.decode_steps,
+            )
+            return toks_out, cache  # [k, B]
+
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
         self._prefill_fn = jax.jit(_prefill, **donate)
         self._decode_fn = jax.jit(_decode, **donate)
+        self._decode_multi_fn = jax.jit(_decode_multi, **donate)
 
     # ------------------------------------------------------------------ API
     def add_request(
@@ -250,18 +295,31 @@ class LLMEngine:
         self.stats.kv_utilization = self.alloc.utilization()
         return self._outputs
 
+    def _prefill_target(self, seq: Sequence) -> int:
+        """Tokens that must be processed chunk-wise before decode can take over.
+
+        Fresh sequence: the whole prompt (last logits sample the first token).
+        Preempted-with-generated-tokens: recompute through len-1; the decode path then
+        feeds the final token and continues sampling (recompute semantics).
+        """
+        if len(seq.token_ids) == seq.prompt_len:
+            return seq.prompt_len
+        return len(seq.token_ids) - 1
+
     def _prefilling(self) -> Optional[Sequence]:
-        cands = [s for s in self.running if s is not None and s.num_computed < s.prompt_len]
+        cands = [
+            s for s in self.running
+            if s is not None and s.num_computed < self._prefill_target(s)
+        ]
         return min(cands, key=lambda s: s.arrival_time) if cands else None
 
     def _step_prefill(self) -> None:
         seq = self._prefilling()
         if seq is None:
             return
-        ps = self.cfg.page_size
         chunk = self.cfg.prefill_chunk
         start = seq.num_computed
-        n = min(chunk, seq.prompt_len - start)
+        n = min(chunk, self._prefill_target(seq) - start)
         if not self._ensure_pages(seq, start + n):
             if not self._preempt_one():
                 return
@@ -283,8 +341,8 @@ class LLMEngine:
         seq.maybe_commit_blocks(self.alloc)
         self.stats.total_prefill_tokens += n
 
-        if seq.num_computed == seq.prompt_len:
-            # sample first token from the last prompt position's logits
+        if len(seq.token_ids) == seq.prompt_len and seq.num_computed == seq.prompt_len:
+            # fresh prefill complete: sample the first token from the last prompt logits
             self._sample_and_append([seq], logits[None, n - 1])
 
     def _step_decode(self) -> None:
@@ -295,13 +353,29 @@ class LLMEngine:
         if not active:
             return
         B = self.cfg.max_batch_size
-        for s in list(active):
-            if s.slot < 0:
-                continue  # preempted by an earlier iteration of this loop
-            while not self._ensure_pages(s, len(s.token_ids)):
-                if not self._preempt_one() or s.slot < 0:
-                    break
-        active = [s for s in active if s.slot >= 0 and len(s.pages) * self.cfg.page_size >= len(s.token_ids)]
+        k = max(1, self.cfg.decode_steps)
+        # A k-step scan writes KV for positions len-1 .. len+k-2 → needs len+k-1 slots.
+        # If the pool can't cover the full horizon, degrade to single-step (horizon
+        # len) rather than preempting a sequence that could still make progress.
+        if k > 1:
+            ok = all(
+                self._ensure_pages(s, min(len(s.token_ids) + k - 1, self.cfg.max_model_len))
+                for s in active if s.slot >= 0
+            )
+            if not ok:
+                k = 1
+        if k == 1:
+            for s in list(active):
+                if s.slot < 0:
+                    continue  # preempted by an earlier iteration of this loop
+                while not self._ensure_pages(s, len(s.token_ids)):
+                    if not self._preempt_one() or s.slot < 0:
+                        break
+        active = [
+            s for s in active
+            if s.slot >= 0 and len(s.pages) * self.cfg.page_size
+            >= min(len(s.token_ids) + k - 1, self.cfg.max_model_len)
+        ]
         if not active:
             return
 
@@ -316,15 +390,70 @@ class LLMEngine:
             pts[i, : len(s.pages)] = s.pages
             lens[i] = len(s.token_ids)
 
-        logits, self.cache = self._decode_fn(
-            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(pts), jnp.asarray(lens),
-        )
+        if k == 1:
+            logits, self.cache = self._decode_fn(
+                self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+                jnp.asarray(pts), jnp.asarray(lens),
+            )
+            for s in active:
+                s.num_computed = len(s.token_ids)
+                s.maybe_commit_blocks(self.alloc)
+            self.stats.total_decode_tokens += len(active)
+            self._sample_and_append(active, logits, slot_indexed=True)
+            return
+        self._step_decode_multi(active, toks, pos, pts, lens, k)
+
+    def _step_decode_multi(self, active, toks, pos, pts, lens, k: int) -> None:
+        B = self.cfg.max_batch_size
+        temp = np.zeros((B,), np.float32)
+        tk = np.zeros((B,), np.int32)
+        tp = np.ones((B,), np.float32)
+        mask = np.zeros((B,), bool)
         for s in active:
-            s.num_computed = len(s.token_ids)
+            sp: SamplingParams = s.sampling
+            temp[s.slot], tk[s.slot], tp[s.slot] = sp.temperature, sp.top_k, sp.top_p
+            mask[s.slot] = True
+        self._key, sub = jax.random.split(self._key)
+        toks_out, self.cache = self._decode_multi_fn(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(tk),
+            jnp.asarray(tp), sub, jnp.asarray(mask),
+        )
+        toks_out = np.asarray(toks_out)  # [k, B]
+        now = time.monotonic()
+        for s in active:
+            new = [int(t) for t in toks_out[:, s.slot]]
+            kept: list[int] = []
+            finished, reason = False, None
+            for t in new:
+                kept.append(t)
+                s.token_ids.append(t)
+                finished, reason = self._check_finish(s, t)
+                if finished:
+                    break
+            # the newest token's KV is never written yet → computed = len - 1
+            s.num_computed = len(s.token_ids) - 1
+            if s.first_token_time is None:
+                s.first_token_time = now
             s.maybe_commit_blocks(self.alloc)
-        self.stats.total_decode_tokens += len(active)
-        self._sample_and_append(active, logits, slot_indexed=True)
+            self.stats.total_decode_tokens += len(kept)
+            if finished:
+                self._retire(s, reason)
+            self._outputs.append(EngineOutput(
+                request_id=s.request_id, new_token_ids=kept, finished=finished,
+                finish_reason=reason, num_cached_prompt_tokens=s.num_cached_prompt,
+                prompt_len=s.prompt_len,
+            ))
+
+    def _retire(self, seq: Sequence, reason: Optional[str]) -> None:
+        """Shared retirement path: free slot + pages, drop from the live map."""
+        seq.finished = True
+        seq.finish_reason = reason
+        if seq.slot >= 0:
+            self.running[seq.slot] = None
+            seq.slot = -1
+        self._free_seq(seq)
+        self.seqs.pop(seq.request_id, None)
 
     def _sample_and_append(self, seqs: list[Sequence], logits: jax.Array, slot_indexed: bool = False) -> None:
         B = logits.shape[0]
@@ -351,12 +480,7 @@ class LLMEngine:
                 s.first_token_time = now
             finished, reason = self._check_finish(s, tok)
             if finished:
-                s.finished = True
-                s.finish_reason = reason
-                self.running[s.slot] = None
-                s.slot = -1
-                self._free_seq(s)
-                self.seqs.pop(s.request_id, None)
+                self._retire(s, reason)
             self._outputs.append(EngineOutput(
                 request_id=s.request_id, new_token_ids=[tok], finished=finished,
                 finish_reason=reason, num_cached_prompt_tokens=s.num_cached_prompt,

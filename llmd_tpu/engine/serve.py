@@ -1,0 +1,71 @@
+"""CLI: python -m llmd_tpu.engine.serve --model tiny --port 8000 [--cpu] ...
+
+The vLLM-serve analogue for the TPU engine (flag names mirror the reference's
+modelserver args where they exist, e.g. --block-size / --kv-events-port).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny", help="name from llmd_tpu.models.MODEL_REGISTRY")
+    ap.add_argument("--served-model-name", default=None)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-pages", type=int, default=512)
+    ap.add_argument("--max-model-len", type=int, default=2048)
+    ap.add_argument("--max-batch-size", type=int, default=8)
+    ap.add_argument("--prefill-chunk", type=int, default=128)
+    ap.add_argument("--decode-steps", type=int, default=4)
+    ap.add_argument("--kv-events-port", type=int, default=None,
+                    help="bind ZMQ KV-event PUB here (pod-discovery mode)")
+    ap.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
+    ap.add_argument("--role", default="both", choices=["both", "prefill", "decode"])
+    ap.add_argument("--cpu", action="store_true", help="force CPU platform (dev)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from llmd_tpu.engine.config import EngineConfig
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.engine.tokenizer import load_tokenizer
+    from llmd_tpu.models import get_model_config
+
+    model_cfg = get_model_config(args.model)
+    engine_cfg = EngineConfig(
+        page_size=args.block_size, num_pages=args.num_pages,
+        max_model_len=args.max_model_len, max_batch_size=args.max_batch_size,
+        prefill_chunk=args.prefill_chunk, decode_steps=args.decode_steps,
+        role=args.role,
+    )
+    server = EngineServer(
+        model_cfg, engine_cfg,
+        model_name=args.served_model_name or f"llmd-tpu/{args.model}",
+        host=args.host, port=args.port, kv_events_port=args.kv_events_port,
+        tokenizer=load_tokenizer(args.tokenizer),
+    )
+
+    async def run() -> None:
+        await server.start()
+        print(f"llmd-tpu engine serving {server.model_name} on http://{server.address} "
+              f"(kv-events port {server.kv_events_port})", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,258 @@
+"""OpenAI-compatible HTTP server over the TPU engine.
+
+Implements the model-server contract the router consumes (reference
+docs/architecture/core/model-servers.md): OpenAI endpoints (+SSE streaming), render
+endpoints for the router's token-producer (kv-indexer.md:104-113), Prometheus /metrics
+with vLLM-compatible names (:38-52), /health probes (:81-86), and ZMQ KV-event
+publishing in pod-discovery mode (kv-indexer.md:67-87).
+
+Run: python -m llmd_tpu.engine.serve --model tiny --port 8000
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from llmd_tpu.core.kv_events import KVEvent, encode_event_batch, kv_topic
+from llmd_tpu.core.request import SamplingParams, flatten_messages
+from llmd_tpu.engine.async_engine import AsyncLLMEngine
+from llmd_tpu.engine.config import EngineConfig
+from llmd_tpu.engine.engine import LLMEngine
+from llmd_tpu.engine.tokenizer import Tokenizer, load_tokenizer
+from llmd_tpu.models.config import ModelConfig
+
+
+def _sampling_from_body(body: dict) -> SamplingParams:
+    return SamplingParams(
+        max_tokens=int(body.get("max_tokens", 16)),
+        temperature=float(body.get("temperature", 1.0)),
+        top_p=float(body.get("top_p", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        stop=body.get("stop") or (),
+        seed=body.get("seed"),
+        n=int(body.get("n", 1)),
+        presence_penalty=float(body.get("presence_penalty", 0.0)),
+        frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+        ignore_eos=bool(body.get("ignore_eos", False)),
+    )
+
+
+class EngineServer:
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        model_name: str = "llmd-tpu/model",
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        kv_events_port: Optional[int] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        params=None,
+    ) -> None:
+        self.model_name = model_name
+        self.host, self.port = host, port
+        self.tokenizer = tokenizer or load_tokenizer()
+        self.kv_events_port = kv_events_port
+        self._zctx = None
+        self._pub = None
+        self._kv_seq = 0
+        self._pending_events: list[KVEvent] = []
+        self._ev_lock = __import__("threading").Lock()
+
+        self.engine = LLMEngine(model_cfg, engine_cfg, params=params,
+                                event_sink=self._on_kv_events)
+        self.async_engine = AsyncLLMEngine(self.engine)
+        self._runner: Optional[web.AppRunner] = None
+        self.request_count = 0
+
+    # -- KV events ---------------------------------------------------------
+    def _on_kv_events(self, events: list[KVEvent]) -> None:
+        """Called from the engine thread; buffered, flushed on the event loop."""
+        if self.kv_events_port is None:
+            return
+        with self._ev_lock:
+            self._pending_events.extend(events)
+
+    async def _kv_flush_loop(self) -> None:
+        import zmq
+
+        while True:
+            await asyncio.sleep(0.01)
+            with self._ev_lock:
+                events, self._pending_events = self._pending_events, []
+            if events and self._pub is not None:
+                self._kv_seq += 1
+                topic = kv_topic(self.address, self.model_name).encode()
+                try:
+                    await self._pub.send_multipart(
+                        [topic, encode_event_batch(events, self._kv_seq)], flags=zmq.NOBLOCK
+                    )
+                except Exception:
+                    pass  # PUB with no subscribers / full HWM: drop (fire-and-forget)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self.async_engine.start()
+        app = web.Application(client_max_size=32 * 1024 * 1024)
+        app.router.add_post("/v1/completions", self._completions)
+        app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/completions/render", self._render)
+        app.router.add_post("/v1/chat/completions/render", self._render)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/v1/models", self._models)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        if self.kv_events_port is not None:
+            import zmq
+            import zmq.asyncio
+
+            self._zctx = zmq.asyncio.Context()
+            self._pub = self._zctx.socket(zmq.PUB)
+            if self.kv_events_port == 0:
+                self.kv_events_port = self._pub.bind_to_random_port("tcp://0.0.0.0")
+            else:
+                self._pub.bind(f"tcp://0.0.0.0:{self.kv_events_port}")
+            asyncio.get_running_loop().create_task(self._kv_flush_loop())
+
+    async def stop(self) -> None:
+        self.async_engine.stop()
+        if self._runner:
+            await self._runner.cleanup()
+        if self._pub is not None:
+            self._pub.close(0)
+            self._zctx.term()
+
+    # -- helpers -----------------------------------------------------------
+    def _tokenize_body(self, body: dict) -> list[int]:
+        if body.get("prompt_token_ids"):
+            return list(body["prompt_token_ids"])
+        if "messages" in body:
+            text = flatten_messages(body["messages"])
+        else:
+            text = str(body.get("prompt", ""))
+        return self.tokenizer.encode(text)
+
+    # -- handlers ----------------------------------------------------------
+    async def _completions(self, request: web.Request):
+        return await self._generate(request, chat=False)
+
+    async def _chat(self, request: web.Request):
+        return await self._generate(request, chat=True)
+
+    async def _generate(self, request: web.Request, chat: bool):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        self.request_count += 1
+        token_ids = self._tokenize_body(body)
+        sampling = _sampling_from_body(body)
+        if not sampling.ignore_eos:
+            sampling.stop_token_ids = tuple(sampling.stop_token_ids) + (self.tokenizer.eos_id,)
+        rid = f"cmpl-{uuid.uuid4().hex[:16]}"
+        stream = bool(body.get("stream", False))
+        created = int(time.time())
+        model = body.get("model", self.model_name)
+
+        try:
+            gen = self.async_engine.generate(rid, token_ids, sampling)
+            if not stream:
+                out_ids: list[int] = []
+                cached = 0
+                reason = None
+                async for out in gen:
+                    out_ids.extend(out.new_token_ids)
+                    cached = out.num_cached_prompt_tokens
+                    reason = out.finish_reason
+                text = self.tokenizer.decode(out_ids)
+                usage = {
+                    "prompt_tokens": len(token_ids), "completion_tokens": len(out_ids),
+                    "total_tokens": len(token_ids) + len(out_ids), "cached_tokens": cached,
+                }
+                choice = (
+                    {"index": 0, "message": {"role": "assistant", "content": text},
+                     "finish_reason": reason}
+                    if chat else
+                    {"index": 0, "text": text, "finish_reason": reason}
+                )
+                return web.json_response({
+                    "id": rid, "object": "chat.completion" if chat else "text_completion",
+                    "created": created, "model": model, "usage": usage, "choices": [choice],
+                })
+
+            resp = web.StreamResponse(headers={
+                "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
+            })
+            await resp.prepare(request)
+            n_out = 0
+            async for out in gen:
+                piece = self.tokenizer.decode(out.new_token_ids)
+                n_out += len(out.new_token_ids)
+                chunk = {
+                    "id": rid, "created": created, "model": model,
+                    "object": "chat.completion.chunk" if chat else "text_completion",
+                    "choices": [
+                        {"index": 0, "delta": {"content": piece},
+                         "finish_reason": out.finish_reason if out.finished else None}
+                        if chat else
+                        {"index": 0, "text": piece,
+                         "finish_reason": out.finish_reason if out.finished else None}
+                    ],
+                }
+                if out.finished:
+                    chunk["usage"] = {
+                        "prompt_tokens": len(token_ids), "completion_tokens": n_out,
+                        "total_tokens": len(token_ids) + n_out,
+                        "cached_tokens": out.num_cached_prompt_tokens,
+                    }
+                await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+
+    async def _render(self, request: web.Request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        return web.json_response({"prompt_token_ids": self._tokenize_body(body)})
+
+    async def _metrics(self, request: web.Request):
+        s = self.engine.stats
+        cfg = self.engine.cfg
+        lines = [
+            f"vllm:num_requests_waiting {s.num_waiting}",
+            f"vllm:num_requests_running {s.num_running}",
+            f"vllm:kv_cache_usage_perc {s.kv_utilization:.6f}",
+            f'vllm:cache_config_info{{block_size="{cfg.page_size}",num_gpu_blocks="{cfg.num_pages}"}} 1',
+            # native duplicates
+            f"llmd_tpu:prefill_tokens_total {s.total_prefill_tokens}",
+            f"llmd_tpu:decode_tokens_total {s.total_decode_tokens}",
+            f"llmd_tpu:preemptions_total {s.total_preemptions}",
+            f"llmd_tpu:requests_total {self.request_count}",
+        ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def _health(self, request: web.Request):
+        return web.json_response({"status": "ok"})
+
+    async def _models(self, request: web.Request):
+        return web.json_response(
+            {"object": "list", "data": [{"id": self.model_name, "object": "model"}]}
+        )

@@ -1,0 +1,61 @@
+"""Tokenizers: offline-safe byte-level default, HF tokenizer when files exist locally.
+
+The byte tokenizer doubles as the contract shared with the router's token-producer in
+tests (testing/fake_server.fake_tokenize uses the same byte mapping for ids 0-255).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    bos_id: int
+    eos_id: int
+    vocab_size: int
+
+    def encode(self, text: str) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """ids 0..255 = raw bytes; 256 = BOS; 257 = EOS."""
+
+    bos_id = 256
+    eos_id = 257
+    vocab_size = 258
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return ([self.bos_id] + ids) if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """transformers tokenizer loaded from a LOCAL path only (zero-egress image)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_id = self._tok.bos_token_id or 0
+        self.eos_id = self._tok.eos_token_id or 0
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=add_bos)
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+
+def load_tokenizer(path: Optional[str] = None) -> Tokenizer:
+    if path and os.path.isdir(path):
+        try:
+            return HFTokenizer(path)
+        except Exception:
+            pass
+    return ByteTokenizer()

@@ -1,0 +1,21 @@
+"""Router / EPP-equivalent: the scheduling brain of the stack.
+
+Re-implements the reference's Endpoint Picker (docs/architecture/core/router/epp/):
+request parsing → flow control → Filter→Score→Pick scheduling → endpoint choice,
+with the data layer feeding per-endpoint metrics and the KV plane feeding prefix
+affinity. Runs standalone (built-in HTTP proxy, file-discovery) — the analogue of the
+reference's no-Kubernetes mode (guides/no-kubernetes-deployment/) — with the same
+plugin-config surface so k8s-mode wiring is config, not code.
+"""
+
+from llmd_tpu.router.plugins import (  # noqa: F401
+    PLUGIN_REGISTRY,
+    Filter,
+    Picker,
+    Scorer,
+    DataProducer,
+    Admitter,
+    register_plugin,
+    build_plugin,
+)
+from llmd_tpu.router.scheduler import Scheduler, SchedulingResult  # noqa: F401

@@ -1,0 +1,101 @@
+"""Data layer: Source→Extract→Attribute runtime feeding Endpoint.attrs.
+
+Parity: reference epp/datalayer.md:5-91 — PollingDataSource scraping each endpoint's
+/metrics (core-metrics-extractor mapping engine names → standard keys), plus the
+file-discovery endpoint source for no-Kubernetes mode
+(guides/no-kubernetes-deployment/router/epp/config.yaml:10-41). A k8s watch source
+slots in behind the same EndpointPool interface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Optional
+
+import aiohttp
+
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool, EndpointRole
+from llmd_tpu.core.metrics_contract import map_engine_metrics, parse_prometheus
+
+
+class MetricsPoller:
+    """Polls every pool endpoint's Prometheus endpoint on an interval (HOT POLL)."""
+
+    def __init__(self, pool: EndpointPool, interval_s: float = 0.5,
+                 timeout_s: float = 2.0, metrics_path: str = "/metrics") -> None:
+        self.pool = pool
+        self.interval = interval_s
+        self.timeout = aiohttp.ClientTimeout(total=timeout_s)
+        self.metrics_path = metrics_path
+        self._task: Optional[asyncio.Task] = None
+        self.poll_count = 0
+        self.error_counts: dict[str, int] = {}
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+    async def poll_once(self, session: aiohttp.ClientSession) -> None:
+        async def one(ep: Endpoint) -> None:
+            try:
+                async with session.get(
+                    f"http://{ep.address}{self.metrics_path}", timeout=self.timeout
+                ) as resp:
+                    text = await resp.text()
+                mapped = map_engine_metrics(ep.engine_type, parse_prometheus(text))
+                for k, v in mapped.items():
+                    ep.attrs.put(k, v)
+                ep.attrs.put("last_poll_ok", time.monotonic())
+            except Exception:
+                self.error_counts[ep.address] = self.error_counts.get(ep.address, 0) + 1
+
+        await asyncio.gather(*(one(e) for e in self.pool.list()))
+        self.poll_count += 1
+
+    async def _loop(self) -> None:
+        async with aiohttp.ClientSession() as session:
+            while True:
+                await self.poll_once(session)
+                await asyncio.sleep(self.interval)
+
+
+def load_endpoints_file(pool: EndpointPool, path: str) -> None:
+    """file-discovery: static endpoint list (JSON or line format 'addr[,role[,k=v...]]')."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        entries = json.loads(text)
+    except json.JSONDecodeError:
+        entries = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split(",")
+            entry = {"address": parts[0]}
+            if len(parts) > 1:
+                entry["role"] = parts[1]
+            entry["labels"] = dict(p.split("=", 1) for p in parts[2:] if "=" in p)
+            entries.append(entry)
+    for e in entries:
+        pool.upsert(Endpoint(
+            address=e["address"],
+            role=EndpointRole(e.get("role", "both")),
+            labels=e.get("labels", {}),
+            engine_type=e.get("engineType", "vllm"),
+        ))
+
+
+def add_static_endpoints(pool: EndpointPool, addresses: list[str],
+                         role: str = "both") -> None:
+    for a in addresses:
+        pool.upsert(Endpoint(address=a, role=EndpointRole(role)))

@@ -1,0 +1,86 @@
+"""Filter and Picker plugins (reference epp/scheduling.md:77-83, 104-108)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Optional
+
+from llmd_tpu.core.endpoint import Endpoint, EndpointRole
+from llmd_tpu.core.metrics_contract import StdMetric
+from llmd_tpu.core.request import InferenceRequest
+from llmd_tpu.router.plugins import register_plugin
+from llmd_tpu.router.scorers import STATE_PREFIX_HITS
+
+
+@register_plugin("label-selector-filter")
+class LabelSelectorFilter:
+    def __init__(self, **labels: str) -> None:
+        self.labels = labels
+
+    def filter(self, req: InferenceRequest, endpoints: list[Endpoint]) -> list[Endpoint]:
+        return [
+            e for e in endpoints
+            if all(e.labels.get(k) == v for k, v in self.labels.items())
+        ]
+
+
+@register_plugin("prefill-endpoints-filter")
+class PrefillEndpointsFilter:
+    def filter(self, req: InferenceRequest, endpoints: list[Endpoint]) -> list[Endpoint]:
+        return [e for e in endpoints if e.role in (EndpointRole.PREFILL, EndpointRole.BOTH)]
+
+
+@register_plugin("decode-endpoints-filter")
+class DecodeEndpointsFilter:
+    def filter(self, req: InferenceRequest, endpoints: list[Endpoint]) -> list[Endpoint]:
+        return [e for e in endpoints if e.role in (EndpointRole.DECODE, EndpointRole.BOTH)]
+
+
+@register_plugin("prefix-cache-affinity-filter")
+class PrefixCacheAffinityFilter:
+    """Epsilon-greedy prefix affinity with a load gate (latency-predictor.md:110-115):
+    keep the best-prefix endpoints unless overloaded; epsilon of traffic explores."""
+
+    def __init__(self, epsilon: float = 0.05, queue_gate: float = 16.0) -> None:
+        self.epsilon = epsilon
+        self.queue_gate = queue_gate
+
+    def filter(self, req: InferenceRequest, endpoints: list[Endpoint]) -> list[Endpoint]:
+        hits = req.state.get(STATE_PREFIX_HITS) or {}
+        if not hits or random.random() < self.epsilon:
+            return endpoints
+        best = max(hits.values())
+        if best <= 0:
+            return endpoints
+        keep = [
+            e for e in endpoints
+            if hits.get(e.address, 0) == best
+            and e.metric(StdMetric.QUEUED_REQUESTS) < self.queue_gate
+        ]
+        return keep or endpoints
+
+
+@register_plugin("max-score-picker")
+class MaxScorePicker:
+    def pick(self, req: InferenceRequest, scored: dict[Endpoint, float]) -> Optional[Endpoint]:
+        if not scored:
+            return None
+        mx = max(scored.values())
+        best = [e for e, s in scored.items() if s >= mx - 1e-9]
+        return random.choice(best)  # tie-break uniformly
+
+
+@register_plugin("random-picker")
+class RandomPicker:
+    def pick(self, req: InferenceRequest, scored: dict[Endpoint, float]) -> Optional[Endpoint]:
+        return random.choice(list(scored)) if scored else None
+
+
+@register_plugin("weighted-random-picker")
+class WeightedRandomPicker:
+    def pick(self, req: InferenceRequest, scored: dict[Endpoint, float]) -> Optional[Endpoint]:
+        if not scored:
+            return None
+        eps = 1e-6
+        eps_weights = [s + eps for s in scored.values()]
+        return random.choices(list(scored), weights=eps_weights, k=1)[0]

@@ -1,0 +1,254 @@
+"""Flow control: pool defense via priority bands, fairness, ordering, saturation.
+
+Parity: reference epp/flow-control.md —
+- FlowKey = (FairnessID, Priority), 3-tier dispatch Priority→Fairness→Ordering
+  (:25-44), band capacity maxBytes/maxRequests, TTL eviction,
+- FairnessPolicy: round-robin | global-strict; OrderingPolicy: fcfs | edf |
+  slo-deadline (:242-254),
+- SaturationDetector gates the dispatch loop (utilization-detector default,
+  concurrency-detector) (:293-344),
+- queues are in-memory only, lost on crash (:354); outcome → HTTP mapping lives in
+  core.request.RequestOutcome (429/503/500).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from llmd_tpu.core.config import FlowControlSpec, PriorityBandSpec
+from llmd_tpu.core.endpoint import EndpointPool
+from llmd_tpu.core.metrics_contract import StdMetric
+from llmd_tpu.core.request import InferenceRequest, RequestOutcome
+
+
+@dataclass
+class QueuedItem:
+    req: InferenceRequest
+    enqueue_time: float
+    future: asyncio.Future  # resolves to RequestOutcome
+    byte_size: int
+
+    def deadline(self) -> float:
+        """EDF deadline: SLO-TTFT if present, else arrival+TTL ordering proxy."""
+        if self.req.slo_ttft_ms is not None:
+            return self.req.arrival_time + self.req.slo_ttft_ms / 1000.0
+        return self.enqueue_time + 3600.0
+
+
+class SaturationDetector:
+    def saturated(self, pool: EndpointPool) -> bool:
+        raise NotImplementedError
+
+
+class UtilizationDetector(SaturationDetector):
+    """Saturated when every endpoint is above kv-util or queue thresholds
+    (flow-control.md utilization-detector defaults)."""
+
+    def __init__(self, kv_threshold: float = 0.95, queue_threshold: int = 5) -> None:
+        self.kv_threshold = kv_threshold
+        self.queue_threshold = queue_threshold
+
+    def saturated(self, pool: EndpointPool) -> bool:
+        eps = pool.list()
+        if not eps:
+            return True
+        return all(
+            e.metric(StdMetric.KV_UTILIZATION) >= self.kv_threshold
+            or e.metric(StdMetric.QUEUED_REQUESTS) >= self.queue_threshold
+            for e in eps
+        )
+
+
+class ConcurrencyDetector(SaturationDetector):
+    def __init__(self, max_inflight_per_endpoint: int = 64,
+                 inflight: Optional[dict[str, int]] = None) -> None:
+        self.limit = max_inflight_per_endpoint
+        self.inflight = inflight if inflight is not None else {}
+
+    def saturated(self, pool: EndpointPool) -> bool:
+        eps = pool.list()
+        if not eps:
+            return True
+        return all(self.inflight.get(e.address, 0) >= self.limit for e in eps)
+
+
+DETECTORS: dict[str, Callable[..., SaturationDetector]] = {
+    "utilization-detector": UtilizationDetector,
+    "concurrency-detector": ConcurrencyDetector,
+}
+
+
+class PriorityBand:
+    """One priority level: per-fairness-id flow queues + fairness + ordering policy."""
+
+    def __init__(self, spec: PriorityBandSpec) -> None:
+        self.spec = spec
+        self.flows: OrderedDict[str, deque[QueuedItem]] = OrderedDict()
+        self.bytes = 0
+        self.count = 0
+
+    def over_capacity(self, item_bytes: int) -> bool:
+        return (self.count + 1 > self.spec.max_requests
+                or self.bytes + item_bytes > self.spec.max_bytes)
+
+    def push(self, item: QueuedItem) -> None:
+        fid = item.req.fairness_id
+        q = self.flows.get(fid)
+        if q is None:
+            q = self.flows[fid] = deque()
+        q.append(item)
+        self.bytes += item.byte_size
+        self.count += 1
+
+    def _order_key(self, item: QueuedItem) -> float:
+        if self.spec.ordering_policy == "fcfs":
+            return item.enqueue_time
+        if self.spec.ordering_policy in ("edf", "slo-deadline"):
+            return item.deadline()
+        return item.enqueue_time
+
+    def pop(self) -> Optional[QueuedItem]:
+        """Fairness across flows, ordering within the chosen flow."""
+        while self.flows:
+            if self.spec.fairness_policy == "global-strict":
+                # globally best item across all flows by ordering key
+                best_fid, best_item = None, None
+                for fid, q in self.flows.items():
+                    if not q:
+                        continue
+                    cand = min(q, key=self._order_key)
+                    if best_item is None or self._order_key(cand) < self._order_key(best_item):
+                        best_fid, best_item = fid, cand
+                if best_item is None:
+                    return None
+                self.flows[best_fid].remove(best_item)
+                if not self.flows[best_fid]:
+                    del self.flows[best_fid]
+                item = best_item
+            else:  # round-robin over flows
+                fid, q = next(iter(self.flows.items()))
+                self.flows.move_to_end(fid)
+                if not q:
+                    del self.flows[fid]
+                    continue
+                item = min(q, key=self._order_key) if self.spec.ordering_policy != "fcfs" else q[0]
+                q.remove(item)
+                if not q:
+                    del self.flows[fid]
+            self.bytes -= item.byte_size
+            self.count -= 1
+            return item
+        return None
+
+    def evict_expired(self, now: float) -> list[QueuedItem]:
+        out = []
+        for fid in list(self.flows):
+            q = self.flows[fid]
+            keep: deque[QueuedItem] = deque()
+            for item in q:
+                if now - item.enqueue_time > self.spec.ttl_s:
+                    out.append(item)
+                    self.bytes -= item.byte_size
+                    self.count -= 1
+                else:
+                    keep.append(item)
+            if keep:
+                self.flows[fid] = keep
+            else:
+                del self.flows[fid]
+        return out
+
+
+class FlowController:
+    """EnqueueAndWait front + saturation-gated dispatch worker (flow-control.md:258-295)."""
+
+    def __init__(self, spec: FlowControlSpec, pool: EndpointPool,
+                 ctx: Optional[dict[str, Any]] = None) -> None:
+        self.spec = spec
+        self.pool = pool
+        if not spec.bands:
+            spec.bands = [PriorityBandSpec(priority=0, name="default")]
+        # higher priority value = more important; dispatch highest first
+        self.bands: dict[int, PriorityBand] = {
+            b.priority: PriorityBand(b) for b in spec.bands
+        }
+        det_cls = DETECTORS.get(spec.saturation_detector, UtilizationDetector)
+        if spec.saturation_detector == "concurrency-detector":
+            self.detector = det_cls(inflight=(ctx or {}).get("inflight_requests"))
+        else:
+            self.detector = det_cls()
+        self._task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self.metrics = {
+            "enqueued_total": 0, "dispatched_total": 0, "rejected_capacity_total": 0,
+            "evicted_ttl_total": 0, "queue_depth": 0,
+        }
+        self._shutdown = False
+
+    # -- API ---------------------------------------------------------------
+    async def enqueue_and_wait(self, req: InferenceRequest) -> RequestOutcome:
+        band = self.bands.get(req.priority)
+        if band is None:
+            # snap to nearest lower band, else lowest
+            lower = [p for p in self.bands if p <= req.priority]
+            band = self.bands[max(lower)] if lower else self.bands[min(self.bands)]
+        size = req.byte_size or 1024
+        if band.over_capacity(size):
+            self.metrics["rejected_capacity_total"] += 1
+            return RequestOutcome.REJECTED_CAPACITY
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        band.push(QueuedItem(req=req, enqueue_time=time.monotonic(), future=fut, byte_size=size))
+        self.metrics["enqueued_total"] += 1
+        self._wake.set()
+        return await fut
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._dispatch_loop())
+
+    async def stop(self) -> None:
+        self._shutdown = True
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        for band in self.bands.values():
+            while (item := band.pop()) is not None:
+                if not item.future.done():
+                    item.future.set_result(RequestOutcome.EVICTED_SHUTDOWN)
+
+    # -- worker ------------------------------------------------------------
+    def _total_queued(self) -> int:
+        return sum(b.count for b in self.bands.values())
+
+    async def _dispatch_loop(self) -> None:
+        while True:
+            if self._total_queued() == 0:
+                self._wake.clear()
+                await self._wake.wait()
+            now = time.monotonic()
+            for band in self.bands.values():
+                for item in band.evict_expired(now):
+                    self.metrics["evicted_ttl_total"] += 1
+                    if not item.future.done():
+                        item.future.set_result(RequestOutcome.EVICTED_TTL)
+            if self.detector.saturated(self.pool):
+                await asyncio.sleep(0.01)  # hold dispatch while pool is saturated
+                continue
+            item = None
+            for prio in sorted(self.bands, reverse=True):
+                item = self.bands[prio].pop()
+                if item is not None:
+                    break
+            if item is None:
+                continue
+            self.metrics["dispatched_total"] += 1
+            self.metrics["queue_depth"] = self._total_queued()
+            if not item.future.done():
+                item.future.set_result(RequestOutcome.DISPATCHED)
+            await asyncio.sleep(0)  # yield so dispatched request can start

@@ -1,0 +1,78 @@
+"""Scheduler plugin framework: extension points + registry.
+
+Parity: reference epp/scheduling.md:50-68 — extension points ProfilePicker, Filter,
+Scorer, Picker, ProcessResults; request-handling.md:50-86 — Parser, DataProducer,
+Admitter with auto-wired hooks (PreRequest, ResponseHeaderProcessor,
+ResponseBodyProcessor). Plugin instances are declared in the config graph
+(core/config.FrameworkConfig) by `type` and wired by `name`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Protocol, runtime_checkable
+
+from llmd_tpu.core.endpoint import Endpoint
+from llmd_tpu.core.request import InferenceRequest
+
+
+@runtime_checkable
+class Filter(Protocol):
+    def filter(self, req: InferenceRequest, endpoints: list[Endpoint]) -> list[Endpoint]: ...
+
+
+@runtime_checkable
+class Scorer(Protocol):
+    def score(self, req: InferenceRequest, endpoints: list[Endpoint]) -> dict[Endpoint, float]: ...
+
+
+@runtime_checkable
+class Picker(Protocol):
+    def pick(self, req: InferenceRequest, scored: dict[Endpoint, float]) -> Optional[Endpoint]: ...
+
+
+class DataProducer:
+    """Per-request state producer with lifecycle hooks (request-handling.md:81-86)."""
+
+    def produce(self, req: InferenceRequest, endpoints: list[Endpoint]) -> None: ...
+
+    def pre_request(self, req: InferenceRequest, endpoint: Endpoint) -> None: ...
+
+    def post_response(self, req: InferenceRequest, endpoint: Endpoint,
+                      response_info: dict[str, Any]) -> None: ...
+
+
+class Admitter:
+    """Admission gate evaluated after producers, before scheduling."""
+
+    def admit(self, req: InferenceRequest, endpoints: list[Endpoint]) -> tuple[bool, str]:
+        return True, ""
+
+
+PLUGIN_REGISTRY: dict[str, Callable[..., Any]] = {}
+
+
+def register_plugin(type_name: str):
+    def deco(cls):
+        PLUGIN_REGISTRY[type_name] = cls
+        cls.plugin_type = type_name
+        return cls
+
+    return deco
+
+
+def build_plugin(type_name: str, params: dict[str, Any], ctx: Optional[dict[str, Any]] = None):
+    """Instantiate a plugin type with its config params (+ optional shared context).
+
+    Plugins that need shared services (prefix index, predictor client) declare
+    `needs_ctx = True` and receive the context dict as first arg.
+    """
+    cls = PLUGIN_REGISTRY.get(type_name)
+    if cls is None:
+        raise KeyError(f"unknown plugin type {type_name!r}; known: {sorted(PLUGIN_REGISTRY)}")
+    if getattr(cls, "needs_ctx", False):
+        return cls(ctx or {}, **params)
+    return cls(**params)
+
+
+def known_plugin_types() -> set[str]:
+    return set(PLUGIN_REGISTRY)

@@ -1,0 +1,179 @@
+"""Filter→Score→Pick scheduler + profile handlers.
+
+Parity: reference epp/scheduling.md:7-68 (weighted score sum per profile, picker),
+:110-118 (single-profile / disagg-profile handlers) and
+disaggregation/README.md:50-93 (decode-first decide-then-prefill flow with the
+uncached-suffix decider).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.core.request import InferenceRequest
+from llmd_tpu.router.plugins import (
+    Admitter,
+    DataProducer,
+    Filter,
+    Picker,
+    Scorer,
+    build_plugin,
+)
+from llmd_tpu.router.scorers import STATE_PREFIX_HITS, STATE_TOKEN_IDS
+
+
+@dataclass
+class ProfileRun:
+    name: str
+    endpoint: Optional[Endpoint]
+    scores: dict[Endpoint, float] = field(default_factory=dict)
+
+
+@dataclass
+class SchedulingResult:
+    """Primary endpoint + optional prefill endpoint (P/D) + per-profile detail."""
+
+    endpoint: Optional[Endpoint]
+    prefill_endpoint: Optional[Endpoint] = None
+    profiles: dict[str, ProfileRun] = field(default_factory=dict)
+    rejected: Optional[str] = None
+    latency_s: float = 0.0
+
+
+class Profile:
+    def __init__(self, name: str, entries: list[tuple[Any, float]]) -> None:
+        self.name = name
+        self.filters: list[Filter] = []
+        self.scorers: list[tuple[Scorer, float]] = []
+        self.picker: Optional[Picker] = None
+        for plugin, weight in entries:
+            if hasattr(plugin, "filter"):
+                self.filters.append(plugin)
+            elif hasattr(plugin, "score"):
+                self.scorers.append((plugin, weight))
+            elif hasattr(plugin, "pick"):
+                self.picker = plugin
+
+    def run(self, req: InferenceRequest, endpoints: list[Endpoint]) -> ProfileRun:
+        cands = list(endpoints)
+        for f in self.filters:
+            cands = f.filter(req, cands)
+            if not cands:
+                return ProfileRun(self.name, None)
+        totals: dict[Endpoint, float] = {e: 0.0 for e in cands}
+        for scorer, weight in self.scorers:
+            for e, s in scorer.score(req, cands).items():
+                if e in totals:
+                    totals[e] += weight * s
+        picked = self.picker.pick(req, totals) if self.picker else None
+        return ProfileRun(self.name, picked, totals)
+
+
+class Scheduler:
+    """Built from a FrameworkConfig; owns plugin instances and the shared context."""
+
+    def __init__(self, config: FrameworkConfig, pool: EndpointPool,
+                 ctx: Optional[dict[str, Any]] = None) -> None:
+        self.config = config
+        self.pool = pool
+        self.ctx = ctx if ctx is not None else {}
+        self.plugins: dict[str, Any] = {}
+        for spec in config.plugins:
+            self.plugins[spec.name] = build_plugin(spec.type, spec.params, self.ctx)
+        self.profiles: dict[str, Profile] = {}
+        for prof in config.scheduling_profiles:
+            entries = [(self.plugins[r.plugin_ref], r.weight) for r in prof.plugins]
+            self.profiles[prof.name] = Profile(prof.name, entries)
+        self.producers: list[DataProducer] = [
+            p for p in self.plugins.values() if isinstance(p, DataProducer)
+        ]
+        self.admitters: list[Admitter] = [
+            p for p in self.plugins.values() if isinstance(p, Admitter)
+        ]
+        self.handler = config.profile_handler
+        # disagg decider params (pd-disaggregation values: always / uncached-suffix)
+        raw_fc = config.raw.get("disaggregation", {}) or {}
+        self.pd_threshold_tokens = int(raw_fc.get("uncachedSuffixThreshold", 0))
+        self.metrics = {"scheduled_total": 0, "rejected_total": 0, "pd_splits_total": 0}
+
+    # ------------------------------------------------------------------
+    def schedule(self, req: InferenceRequest) -> SchedulingResult:
+        t0 = time.monotonic()
+        endpoints = self.pool.list()
+        if not endpoints:
+            return SchedulingResult(None, rejected="no endpoints")
+        for p in self.producers:
+            p.produce(req, endpoints)
+        for a in self.admitters:
+            ok, why = a.admit(req, endpoints)
+            if not ok:
+                self.metrics["rejected_total"] += 1
+                return SchedulingResult(None, rejected=why or "admission rejected")
+
+        if self.handler == "disagg-profile-handler":
+            res = self._schedule_disagg(req, endpoints)
+        else:
+            res = self._schedule_single(req, endpoints)
+
+        if res.endpoint is not None:
+            self.metrics["scheduled_total"] += 1
+            for p in self.producers:
+                p.pre_request(req, res.endpoint)
+            nh = self.plugins.get("no-hit-lru-scorer")
+            if nh is not None and hasattr(nh, "note_pick"):
+                hits = req.state.get(STATE_PREFIX_HITS) or {}
+                if not any(v > 0 for v in hits.values()):
+                    nh.note_pick(res.endpoint)
+        res.latency_s = time.monotonic() - t0
+        return res
+
+    def post_response(self, req: InferenceRequest, endpoint: Endpoint,
+                      response_info: dict[str, Any]) -> None:
+        for p in self.producers:
+            p.post_response(req, endpoint, response_info)
+
+    # ------------------------------------------------------------------
+    def _profile(self, name: str) -> Optional[Profile]:
+        return self.profiles.get(name)
+
+    def _schedule_single(self, req, endpoints) -> SchedulingResult:
+        prof = self._profile("default") or next(iter(self.profiles.values()), None)
+        if prof is None:
+            return SchedulingResult(None, rejected="no scheduling profile")
+        run = prof.run(req, endpoints)
+        return SchedulingResult(run.endpoint, profiles={prof.name: run},
+                                rejected=None if run.endpoint else "no endpoint passed filters")
+
+    def _schedule_disagg(self, req, endpoints) -> SchedulingResult:
+        """Decode profile first; decider on uncached suffix; maybe prefill profile.
+
+        Reference disaggregation/README.md:57-91: run decode profile → compute the
+        uncached suffix on the chosen D endpoint → if large enough, run prefill
+        profile and return P in the x-prefiller-host-port header.
+        """
+        dec_prof = self._profile("decode") or self._profile("default")
+        if dec_prof is None:
+            return SchedulingResult(None, rejected="no decode profile")
+        dec = dec_prof.run(req, endpoints)
+        if dec.endpoint is None:
+            return SchedulingResult(None, rejected="no decode endpoint")
+        result = SchedulingResult(dec.endpoint, profiles={dec_prof.name: dec})
+
+        pre_prof = self._profile("prefill")
+        if pre_prof is None:
+            return result
+        hits = req.state.get(STATE_PREFIX_HITS) or {}
+        n_tokens = len(req.state.get(STATE_TOKEN_IDS) or req.prompt_text().encode())
+        uncached = n_tokens - hits.get(dec.endpoint.address, 0)
+        if uncached < self.pd_threshold_tokens:
+            return result  # short uncached suffix: decode-only (aggregated)
+        pre = pre_prof.run(req, [e for e in endpoints if e != dec.endpoint] or endpoints)
+        if pre.endpoint is not None:
+            result.prefill_endpoint = pre.endpoint
+            result.profiles[pre_prof.name] = pre
+            self.metrics["pd_splits_total"] += 1
+        return result

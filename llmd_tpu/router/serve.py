@@ -1,0 +1,82 @@
+"""CLI: python -m llmd_tpu.router.serve --config cfg.yaml --endpoints a:8000,b:8000
+
+No-Kubernetes standalone mode (reference guides/no-kubernetes-deployment/): static
+endpoint discovery via --endpoints or --endpoints-file; config is the plugin graph.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+DEFAULT_CONFIG = """
+plugins:
+  - name: prefix-producer
+    type: approx-prefix-cache-producer
+    params: {blockSize: 16}
+  - name: inflight
+    type: inflight-load-producer
+  - name: prefix
+    type: prefix-cache-scorer
+  - name: queue
+    type: queue-depth-scorer
+  - name: kv-util
+    type: kv-cache-utilization-scorer
+  - name: no-hit-lru-scorer
+    type: no-hit-lru-scorer
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: prefix, weight: 3}
+      - {pluginRef: queue, weight: 2}
+      - {pluginRef: kv-util, weight: 2}
+      - {pluginRef: no-hit-lru-scorer, weight: 2}
+"""
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default=None, help="FrameworkConfig YAML path")
+    ap.add_argument("--endpoints", default=None, help="comma-separated addr list")
+    ap.add_argument("--endpoints-file", default=None, help="file-discovery path")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8080)
+    ap.add_argument("--poll-interval", type=float, default=0.5)
+    args = ap.parse_args()
+
+    from llmd_tpu.core.config import FrameworkConfig
+    from llmd_tpu.core.endpoint import EndpointPool
+    from llmd_tpu.router import plugins as _p  # noqa: F401 (load registry)
+    from llmd_tpu.router import filters_pickers as _fp  # noqa: F401
+    from llmd_tpu.router import scorers as _s  # noqa: F401
+    from llmd_tpu.router.datalayer import add_static_endpoints, load_endpoints_file
+    from llmd_tpu.router.plugins import known_plugin_types
+    from llmd_tpu.router.server import RouterServer
+
+    if args.config:
+        with open(args.config) as f:
+            text = f.read()
+    else:
+        text = DEFAULT_CONFIG
+    config = FrameworkConfig.from_yaml(text, known_types=known_plugin_types())
+
+    pool = EndpointPool()
+    if args.endpoints_file:
+        load_endpoints_file(pool, args.endpoints_file)
+    if args.endpoints:
+        add_static_endpoints(pool, args.endpoints.split(","))
+
+    server = RouterServer(config, pool, host=args.host, port=args.port,
+                          poll_interval_s=args.poll_interval)
+
+    async def run() -> None:
+        await server.start()
+        print(f"llmd-tpu router on http://{server.address} "
+              f"({len(pool)} endpoints)", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,261 @@
+"""Router server: standalone-mode proxy + EPP in one process.
+
+The reference splits this across Envoy (ext-proc client) and the EPP gRPC server
+(proxy.md:16-25, epp/README.md:13-16); standalone mode runs them co-located — this
+server plays that combined role: parse → flow-control gate → schedule → forward to the
+chosen endpoint → stream the response back, emitting x-llm-d-* headers and Prometheus
+metrics (llm_d_epp_* family, observability/metrics.md:95-130).
+
+P/D: when the disagg handler returns a prefill endpoint, the request is forwarded to
+the DECODE endpoint with the x-prefiller-host-port header — the routing sidecar in
+front of the decode engine orchestrates the P→D flow (disaggregation/README.md:104-131).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Any, Optional
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import EndpointPool
+from llmd_tpu.core.request import (
+    HDR_PREFILLER_HOST_PORT,
+    InferenceRequest,
+    RequestOutcome,
+    SamplingParams,
+)
+from llmd_tpu.router.datalayer import MetricsPoller
+from llmd_tpu.router.flowcontrol import FlowController
+from llmd_tpu.router.scheduler import Scheduler
+from llmd_tpu.router.scorers import STATE_TOKEN_IDS
+
+GEN_PATHS = ("/v1/completions", "/v1/chat/completions")
+
+
+def parse_openai_request(path: str, body: dict, headers: dict[str, str]) -> InferenceRequest:
+    """openai-parser (request-handling.md:50-73)."""
+    req = InferenceRequest.from_headers(headers)
+    req.model = str(body.get("model", ""))
+    if "messages" in body:
+        req.messages = body["messages"]
+    else:
+        req.prompt = str(body.get("prompt", ""))
+    req.sampling = SamplingParams(
+        max_tokens=int(body.get("max_tokens", 16)),
+        temperature=float(body.get("temperature", 1.0)),
+    )
+    req.streaming = bool(body.get("stream", False))
+    req.byte_size = len(json.dumps(body))
+    return req
+
+
+class RouterServer:
+    def __init__(
+        self,
+        config: FrameworkConfig,
+        pool: EndpointPool,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+        poll_interval_s: float = 0.5,
+        objectives: Optional[dict[str, int]] = None,  # objective name → priority
+        model_rewrites: Optional[dict[str, list[tuple[str, float]]]] = None,
+    ) -> None:
+        self.config = config
+        self.pool = pool
+        self.host, self.port = host, port
+        self.ctx: dict[str, Any] = {}
+        self.scheduler = Scheduler(config, pool, self.ctx)
+        self.flow: Optional[FlowController] = (
+            FlowController(config.flow_control, pool, self.ctx)
+            if config.flow_control.enabled else None
+        )
+        self.poller = MetricsPoller(pool, interval_s=poll_interval_s)
+        self.objectives = objectives or {}
+        self.model_rewrites = model_rewrites or {}
+        self._runner: Optional[web.AppRunner] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.metrics = {
+            "requests_total": 0, "responses_total": 0, "errors_total": 0,
+            "ttft_sum": 0.0, "ttft_count": 0,
+        }
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        await self.poller.start()
+        if self.flow:
+            await self.flow.start()
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        for path in GEN_PATHS:
+            app.router.add_post(path, self._handle_generate)
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/v1/models", self._models)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        await self.poller.stop()
+        if self.flow:
+            await self.flow.stop()
+        if self._runner:
+            await self._runner.cleanup()
+        if self._session:
+            await self._session.close()
+
+    # ------------------------------------------------------------------
+    def _rewrite_model(self, req: InferenceRequest, body: dict) -> None:
+        """InferenceModelRewrite: weighted model-name rewrite for canary/A-B
+        (docs/api-reference/inferencemodelrewrite.md)."""
+        import random
+
+        targets = self.model_rewrites.get(req.model)
+        if not targets:
+            return
+        names = [t[0] for t in targets]
+        weights = [t[1] for t in targets]
+        chosen = random.choices(names, weights=weights, k=1)[0]
+        body["model"] = chosen
+        req.state["model_rewritten_to"] = chosen
+
+    async def _handle_generate(self, request: web.Request):
+        t_start = time.monotonic()
+        self.metrics["requests_total"] += 1
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        headers = dict(request.headers)
+        req = parse_openai_request(request.path, body, headers)
+        req.request_id = request.headers.get("x-request-id", uuid.uuid4().hex)
+        if req.objective and req.objective in self.objectives:
+            req.priority = self.objectives[req.objective]
+        self._rewrite_model(req, body)
+
+        if self.flow:
+            outcome = await self.flow.enqueue_and_wait(req)
+            if outcome is not RequestOutcome.DISPATCHED:
+                self.metrics["errors_total"] += 1
+                return web.json_response(
+                    {"error": {"message": f"flow control: {outcome.value}"}},
+                    status=outcome.http_status,
+                )
+
+        result = self.scheduler.schedule(req)
+        if result.endpoint is None:
+            self.metrics["errors_total"] += 1
+            return web.json_response(
+                {"error": {"message": f"no endpoint: {result.rejected}"}}, status=503
+            )
+
+        fwd_headers = {"content-type": "application/json"}
+        if result.prefill_endpoint is not None:
+            fwd_headers[HDR_PREFILLER_HOST_PORT] = result.prefill_endpoint.address
+        target = result.endpoint
+
+        try:
+            resp = await self._session.post(
+                f"http://{target.address}{request.path}", json=body, headers=fwd_headers,
+                timeout=aiohttp.ClientTimeout(total=600),
+            )
+        except Exception as e:
+            self.metrics["errors_total"] += 1
+            self.scheduler.post_response(req, target, {"error": str(e)})
+            return web.json_response(
+                {"error": {"message": f"upstream error: {e}"}}, status=502
+            )
+
+        echo = {
+            "x-llm-d-endpoint": target.address,
+            "x-llm-d-request-id": req.request_id,
+        }
+        if result.prefill_endpoint is not None:
+            echo[HDR_PREFILLER_HOST_PORT] = result.prefill_endpoint.address
+
+        try:
+            if resp.headers.get("Content-Type", "").startswith("text/event-stream"):
+                out = web.StreamResponse(
+                    status=resp.status,
+                    headers={"Content-Type": "text/event-stream", **echo},
+                )
+                await out.prepare(request)
+                first = True
+                async for chunk in resp.content.iter_any():
+                    if first:
+                        self.metrics["ttft_sum"] += time.monotonic() - t_start
+                        self.metrics["ttft_count"] += 1
+                        first = False
+                    await out.write(chunk)
+                await out.write_eof()
+                self.scheduler.post_response(req, target, {"status": resp.status})
+                self.metrics["responses_total"] += 1
+                return out
+            payload = await resp.read()
+            self.metrics["ttft_sum"] += time.monotonic() - t_start
+            self.metrics["ttft_count"] += 1
+            info: dict[str, Any] = {"status": resp.status}
+            try:
+                info["usage"] = json.loads(payload).get("usage", {})
+            except Exception:
+                pass
+            self.scheduler.post_response(req, target, info)
+            self.metrics["responses_total"] += 1
+            return web.Response(
+                body=payload, status=resp.status,
+                headers={"Content-Type": "application/json", **echo},
+            )
+        finally:
+            resp.release()
+
+    async def _metrics(self, request: web.Request):
+        m = self.metrics
+        s = self.scheduler.metrics
+        lines = [
+            f"llm_d_epp_requests_total {m['requests_total']}",
+            f"llm_d_epp_responses_total {m['responses_total']}",
+            f"llm_d_epp_errors_total {m['errors_total']}",
+            f"llm_d_epp_scheduled_total {s['scheduled_total']}",
+            f"llm_d_epp_rejected_total {s['rejected_total']}",
+            f"llm_d_epp_pd_splits_total {s['pd_splits_total']}",
+            f"igw_queue_depth {self.flow.metrics['queue_depth'] if self.flow else 0}",
+            f"igw_running_requests {sum(self.ctx.get('inflight_requests', {}).values())}",
+        ]
+        if self.flow:
+            f = self.flow.metrics
+            lines += [
+                f"llm_d_epp_flow_enqueued_total {f['enqueued_total']}",
+                f"llm_d_epp_flow_dispatched_total {f['dispatched_total']}",
+                f"llm_d_epp_flow_rejected_capacity_total {f['rejected_capacity_total']}",
+                f"llm_d_epp_flow_evicted_ttl_total {f['evicted_ttl_total']}",
+            ]
+        if m["ttft_count"]:
+            lines.append(f"llm_d_epp_ttft_seconds_mean {m['ttft_sum'] / m['ttft_count']:.6f}")
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def _health(self, request: web.Request):
+        return web.json_response({"status": "ok", "endpoints": len(self.pool)})
+
+    async def _models(self, request: web.Request):
+        # aggregate /v1/models from one healthy endpoint
+        for ep in self.pool.list():
+            try:
+                async with self._session.get(
+                    f"http://{ep.address}/v1/models",
+                    timeout=aiohttp.ClientTimeout(total=2),
+                ) as r:
+                    return web.json_response(await r.json())
+            except Exception:
+                continue
+        return web.json_response({"object": "list", "data": []})

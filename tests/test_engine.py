@@ -143,3 +143,58 @@ def test_full_pool_prefix_reuse_no_livelock(engine_factory):
     # longer follow-up sharing the prefix; pool is tight but feasible
     out = eng.generate([base + [70, 71, 72]], SamplingParams(max_tokens=2, temperature=0.0))
     assert len(out["req-0"]) == 2
+
+
+def test_multistep_decode_matches_single_step(engine_factory):
+    """decode_steps>1 must yield identical greedy tokens to step-by-step decode."""
+    prompts = [list(range(5, 40)), list(range(50, 90))]
+    single = engine_factory(decode_steps=1)
+    multi = engine_factory(decode_steps=4)
+    o1 = single.generate(prompts, SamplingParams(max_tokens=11, temperature=0.0))
+    o2 = multi.generate(prompts, SamplingParams(max_tokens=11, temperature=0.0))
+    assert o1["req-0"] == o2["req-0"]
+    assert o1["req-1"] == o2["req-1"]
+
+
+def test_multistep_stop_token(engine_factory):
+    prompt = list(range(10, 30))
+    first3 = engine_factory().generate([prompt], SamplingParams(max_tokens=3, temperature=0.0))["req-0"]
+    eng = engine_factory(decode_steps=4)
+    out = eng.generate([prompt], SamplingParams(max_tokens=16, temperature=0.0, stop_token_ids=[first3[2]]))
+    assert out["req-0"] == first3  # truncated mid-scan at the stop token
+
+
+def test_tight_pool_no_horizon_regression(engine_factory):
+    """Reviewer repro: pool of 3 pages, 23-token prompt, 2 generated — must not
+    self-preempt (horizon is len+k-1, not len+k)."""
+    eng = engine_factory(num_pages=3, max_model_len=24, max_batch_size=1, decode_steps=1)
+    ref = engine_factory(num_pages=64, max_model_len=24)
+    p = list(range(1, 24))
+    o1 = eng.generate([p], SamplingParams(max_tokens=2, temperature=0.0))["req-0"]
+    o2 = ref.generate([p], SamplingParams(max_tokens=2, temperature=0.0))["req-0"]
+    assert o1 == o2
+    assert eng.stats.total_preemptions == 0
+
+
+def test_multistep_degrades_in_tight_pool(engine_factory):
+    """decode_steps=4 in a pool that only fits single-step must degrade, not hang,
+    and still produce correct greedy tokens."""
+    eng = engine_factory(num_pages=3, max_model_len=24, max_batch_size=1, decode_steps=4)
+    ref = engine_factory(num_pages=64, max_model_len=24, decode_steps=1)
+    p = list(range(1, 20))
+    o1 = eng.generate([p], SamplingParams(max_tokens=5, temperature=0.0))["req-0"]
+    o2 = ref.generate([p], SamplingParams(max_tokens=5, temperature=0.0))["req-0"]
+    assert o1 == o2
+
+
+def test_preemption_with_generated_tokens_continues(engine_factory):
+    """A sequence preempted mid-generation must resume and continue the SAME
+    continuation (greedy), not restart sampling from the prompt."""
+    ref = engine_factory(num_pages=64, max_batch_size=2)
+    prompts = [list(range(1, 30)), list(range(60, 95))]
+    expected = ref.generate(prompts, SamplingParams(max_tokens=16, temperature=0.0))
+    tight = engine_factory(num_pages=10, max_batch_size=2, enable_prefix_caching=False)
+    got = tight.generate(prompts, SamplingParams(max_tokens=16, temperature=0.0))
+    assert tight.stats.total_preemptions > 0  # the point of the test
+    for k in expected:
+        assert got[k] == expected[k], k
