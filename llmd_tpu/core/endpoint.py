@@ -139,6 +139,12 @@ class EndpointPool:
         """fn(event: 'added'|'removed', endpoint) — endpoint-notification-source analogue."""
         self._listeners.append(fn)
 
+    def unsubscribe(self, fn: Any) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._eps)

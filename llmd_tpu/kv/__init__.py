@@ -1,0 +1,13 @@
+"""KV-cache management plane.
+
+Re-implements the reference's KV plane (docs/architecture/advanced/kv-management/):
+
+- ``llmd_tpu.kv.indexer``    — the KV-Cache Indexer: a two-level LRU index of which pod
+  holds which KV block on which tier, fed by KV events (kv-indexer.md:59-151).
+- ``llmd_tpu.kv.subscriber`` — ZMQ event subscription manager (centralized or
+  pod-discovery delivery, kv-indexer.md:67-87).
+- ``llmd_tpu.kv.plugins``    — router plugins: token-producer,
+  precise-prefix-cache-producer, precise-prefix-cache-scorer.
+"""
+
+from llmd_tpu.kv.indexer import KVBlockIndex  # noqa: F401

@@ -1,0 +1,226 @@
+"""KV-Cache Indexer: global view of which pod caches which KV block, on which tier.
+
+Parity: reference docs/architecture/advanced/kv-management/kv-indexer.md —
+- two-level LRU backend (default sized 100M keys × 10 pods; here configurable,
+  kv-indexer.md:88-98),
+- longest-consecutive-prefix scoring with tier weights gpu=1.0 / cpu=0.8
+  (kv-indexer.md:119-143),
+- speculative indexing: after the scheduler picks a pod, its prompt's block keys are
+  inserted with a short TTL (default 2s) so back-to-back identical prompts route
+  sticky before the engine's own events arrive (kv-indexer.md:104-143),
+- event application: BlockStored / BlockRemoved / AllBlocksCleared per pod
+  (kv-indexer.md:59-63).
+
+Thread-safe: written from the ZMQ subscriber task, read on every schedule.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from llmd_tpu.core.kv_events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    KVEvent,
+    MEDIUM_CPU,
+    MEDIUM_FS,
+    MEDIUM_HBM,
+)
+
+DEFAULT_TIER_WEIGHTS = {MEDIUM_HBM: 1.0, MEDIUM_CPU: 0.8, MEDIUM_FS: 0.5}
+
+SPECULATIVE_TTL_S = 2.0  # kv-indexer.md speculative indexing TTL
+
+
+@dataclass
+class _PodEntry:
+    tier: str = MEDIUM_HBM
+    # 0.0 → confirmed by an engine event; else monotonic expiry of a speculative entry.
+    spec_expiry: float = 0.0
+
+    def live(self, now: float) -> bool:
+        return self.spec_expiry == 0.0 or now < self.spec_expiry
+
+
+@dataclass
+class PrefixMatch:
+    """Result of the longest-consecutive-prefix walk for one pod."""
+
+    blocks: int = 0  # consecutive blocks matched from the start
+    weighted: float = 0.0  # sum of tier weights over matched blocks
+
+
+@dataclass
+class IndexStats:
+    events_applied: int = 0
+    blocks_stored: int = 0
+    blocks_removed: int = 0
+    clears: int = 0
+    lookups: int = 0
+    evictions: int = 0
+    speculative_inserts: int = 0
+
+
+class KVBlockIndex:
+    """Two-level LRU: block_hash → (pod → tier), both levels capacity-bounded."""
+
+    def __init__(
+        self,
+        max_keys: int = 1_000_000,
+        max_pods_per_key: int = 10,
+        tier_weights: Optional[dict[str, float]] = None,
+        speculative_ttl_s: float = SPECULATIVE_TTL_S,
+    ) -> None:
+        self.max_keys = max_keys
+        self.max_pods_per_key = max_pods_per_key
+        self.tier_weights = dict(tier_weights or DEFAULT_TIER_WEIGHTS)
+        self.spec_ttl = speculative_ttl_s
+        self._lock = threading.RLock()
+        # level 1: block_hash → level 2 (pod → entry), LRU on level 1.
+        self._index: OrderedDict[int, OrderedDict[str, _PodEntry]] = OrderedDict()
+        # reverse map pod → its keys, so AllBlocksCleared / pod removal are
+        # O(keys-for-that-pod), not O(max_keys) under the lock.
+        self._pod_keys: dict[str, set[int]] = {}
+        self.stats = IndexStats()
+
+    def _drop(self, pod: str, block_hash: int) -> None:
+        keys = self._pod_keys.get(pod)
+        if keys is not None:
+            keys.discard(block_hash)
+            if not keys:
+                del self._pod_keys[pod]
+
+    # ---------------------------------------------------------------- events
+    def apply(self, pod: str, event: KVEvent) -> None:
+        with self._lock:
+            self.stats.events_applied += 1
+            if isinstance(event, BlockStored):
+                for h in event.block_hashes:
+                    self._store(pod, h, event.medium, spec_expiry=0.0)
+                self.stats.blocks_stored += len(event.block_hashes)
+            elif isinstance(event, BlockRemoved):
+                for h in event.block_hashes:
+                    pods = self._index.get(h)
+                    if pods is None:
+                        continue
+                    entry = pods.get(pod)
+                    # Only remove the matching tier: a CPU-tier removal must not
+                    # erase knowledge of an HBM-resident copy.
+                    if entry is not None and entry.tier == event.medium:
+                        del pods[pod]
+                        self._drop(pod, h)
+                        if not pods:
+                            del self._index[h]
+                self.stats.blocks_removed += len(event.block_hashes)
+            elif isinstance(event, AllBlocksCleared):
+                for h in self._pod_keys.pop(pod, ()):
+                    pods = self._index.get(h)
+                    if pods is not None:
+                        pods.pop(pod, None)
+                        if not pods:
+                            del self._index[h]
+                self.stats.clears += 1
+
+    def apply_batch(self, pod: str, events: Sequence[KVEvent]) -> None:
+        for ev in events:
+            self.apply(pod, ev)
+
+    def _store(self, pod: str, block_hash: int, tier: str, spec_expiry: float) -> None:
+        pods = self._index.get(block_hash)
+        if pods is None:
+            pods = self._index[block_hash] = OrderedDict()
+        existing = pods.get(pod)
+        if existing is not None:
+            confirmed_new = spec_expiry == 0.0
+            confirmed_old = existing.spec_expiry == 0.0
+            if confirmed_new and not confirmed_old:
+                # engine event confirms a speculative guess
+                existing.tier, existing.spec_expiry = tier, 0.0
+            elif confirmed_new == confirmed_old:
+                # same confidence class: higher tier wins; refresh speculative TTL
+                if self.tier_weights.get(tier, 0.0) >= self.tier_weights.get(existing.tier, 0.0):
+                    existing.tier = tier
+                if not confirmed_new:
+                    existing.spec_expiry = spec_expiry
+            # else: confirmed entry never downgrades to speculative — keep as is
+            pods.move_to_end(pod)
+        else:
+            pods[pod] = _PodEntry(tier=tier, spec_expiry=spec_expiry)
+            self._pod_keys.setdefault(pod, set()).add(block_hash)
+            while len(pods) > self.max_pods_per_key:
+                evicted_pod, _ = pods.popitem(last=False)
+                self._drop(evicted_pod, block_hash)
+                self.stats.evictions += 1
+        self._index.move_to_end(block_hash)
+        while len(self._index) > self.max_keys:
+            evicted_hash, evicted_pods = self._index.popitem(last=False)
+            for p in evicted_pods:
+                self._drop(p, evicted_hash)
+            self.stats.evictions += 1
+
+    # ------------------------------------------------------------- speculative
+    def add_speculative(self, pod: str, block_hashes: Sequence[int],
+                        tier: str = MEDIUM_HBM) -> None:
+        """Insert short-TTL entries after a scheduling pick (kv-indexer.md:104-143)."""
+        expiry = time.monotonic() + self.spec_ttl
+        with self._lock:
+            for h in block_hashes:
+                pods = self._index.get(h)
+                if pods is not None and (e := pods.get(pod)) is not None and e.spec_expiry == 0.0:
+                    continue  # already confirmed; don't overwrite with speculative
+                self._store(pod, h, tier, spec_expiry=expiry)
+            self.stats.speculative_inserts += len(block_hashes)
+
+    # ----------------------------------------------------------------- lookup
+    def lookup(self, block_hashes: Sequence[int],
+               pods: Sequence[str]) -> dict[str, PrefixMatch]:
+        """Longest-consecutive-prefix walk per candidate pod (HOT: every request)."""
+        now = time.monotonic()
+        out = {p: PrefixMatch() for p in pods}
+        live = set(pods)
+        with self._lock:
+            self.stats.lookups += 1
+            for h in block_hashes:
+                if not live:
+                    break
+                entry_pods = self._index.get(h)
+                if not entry_pods:
+                    break
+                matched_any = False
+                for p in list(live):
+                    e = entry_pods.get(p)
+                    if e is None or not e.live(now):
+                        live.discard(p)
+                        continue
+                    m = out[p]
+                    m.blocks += 1
+                    m.weighted += self.tier_weights.get(e.tier, 0.0)
+                    matched_any = True
+                if not matched_any:
+                    break
+        return out
+
+    def pods_for_block(self, block_hash: int) -> dict[str, str]:
+        now = time.monotonic()
+        with self._lock:
+            pods = self._index.get(block_hash) or {}
+            return {p: e.tier for p, e in pods.items() if e.live(now)}
+
+    def remove_pod(self, pod: str) -> None:
+        """Drop every entry for a departed pod (endpoint removed from the pool)."""
+        with self._lock:
+            for h in self._pod_keys.pop(pod, ()):
+                pods = self._index.get(h)
+                if pods is not None:
+                    pods.pop(pod, None)
+                    if not pods:
+                        del self._index[h]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)

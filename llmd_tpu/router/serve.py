@@ -46,7 +46,8 @@ def main() -> None:
 
     from llmd_tpu.core.config import FrameworkConfig
     from llmd_tpu.core.endpoint import EndpointPool
-    from llmd_tpu.router import plugins as _p  # noqa: F401 (load registry)
+    from llmd_tpu.kv import plugins as _kv  # noqa: F401 (load registry)
+    from llmd_tpu.router import plugins as _p  # noqa: F401
     from llmd_tpu.router import filters_pickers as _fp  # noqa: F401
     from llmd_tpu.router import scorers as _s  # noqa: F401
     from llmd_tpu.router.datalayer import add_static_endpoints, load_endpoints_file

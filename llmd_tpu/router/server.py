@@ -76,6 +76,27 @@ class RouterServer:
             if config.flow_control.enabled else None
         )
         self.poller = MetricsPoller(pool, interval_s=poll_interval_s)
+        # Producers exposing an async pre-schedule step (token-producer render call).
+        self._async_producers = [
+            p for p in self.scheduler.producers if hasattr(p, "aproduce")
+        ]
+        # KV-event subscription (precise prefix routing): on when the config declares
+        # a precise producer or an explicit kvEvents section (kv-indexer.md:67-87).
+        self.kv_subscriber = None
+        kv_cfg = (config.raw.get("kvEvents") or {}) if config.raw else {}
+        wants_precise = any(p.type == "precise-prefix-cache-producer" for p in config.plugins)
+        if wants_precise or (config.raw and "kvEvents" in config.raw):
+            from llmd_tpu.kv.indexer import KVBlockIndex
+            from llmd_tpu.kv.plugins import CTX_KV_INDEX
+            from llmd_tpu.kv.subscriber import KVEventSubscriberManager
+
+            index = self.ctx.setdefault(CTX_KV_INDEX, KVBlockIndex())
+            self.kv_subscriber = KVEventSubscriberManager(
+                index, pool,
+                topic_filter=kv_cfg.get("topicFilter", "kv@"),
+                default_events_port=kv_cfg.get("port"),
+                bind_port=kv_cfg.get("bindPort"),
+            )
         self.objectives = objectives or {}
         self.model_rewrites = model_rewrites or {}
         self._runner: Optional[web.AppRunner] = None
@@ -94,6 +115,8 @@ class RouterServer:
         await self.poller.start()
         if self.flow:
             await self.flow.start()
+        if self.kv_subscriber:
+            await self.kv_subscriber.start()
         app = web.Application(client_max_size=64 * 1024 * 1024)
         for path in GEN_PATHS:
             app.router.add_post(path, self._handle_generate)
@@ -110,6 +133,8 @@ class RouterServer:
         await self.poller.stop()
         if self.flow:
             await self.flow.stop()
+        if self.kv_subscriber:
+            await self.kv_subscriber.stop()
         if self._runner:
             await self._runner.cleanup()
         if self._session:
@@ -153,6 +178,8 @@ class RouterServer:
                     status=outcome.http_status,
                 )
 
+        for p in self._async_producers:
+            await p.aproduce(req, self.pool.list(), self._session)
         result = self.scheduler.schedule(req)
         if result.endpoint is None:
             self.metrics["errors_total"] += 1

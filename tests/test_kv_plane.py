@@ -1,0 +1,182 @@
+"""KV plane tests: indexer semantics (kv-indexer.md) + precise prefix routing e2e
+over ZMQ events from fake model servers (precise-prefix-cache-routing guide)."""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.core.kv_events import (
+    AllBlocksCleared,
+    BlockRemoved,
+    BlockStored,
+    MEDIUM_CPU,
+    MEDIUM_HBM,
+    block_keys_for_tokens,
+)
+from llmd_tpu.kv import plugins as _kv  # noqa: F401 (register plugins)
+from llmd_tpu.kv.indexer import KVBlockIndex
+from llmd_tpu.kv.subscriber import LABEL_KV_EVENTS_ADDR
+from llmd_tpu.router import filters_pickers as _fp  # noqa: F401
+from llmd_tpu.router import scorers as _s  # noqa: F401
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.server import RouterServer
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+from tests.conftest import run_async
+
+
+def _stored(keys, parent=None, medium=MEDIUM_HBM):
+    return BlockStored(block_hashes=list(keys), parent_block_hash=parent,
+                       token_ids=[], block_size=16, medium=medium)
+
+
+# ---------------------------------------------------------------- index unit tests
+def test_index_prefix_walk_and_tiers():
+    idx = KVBlockIndex()
+    idx.apply("podA", _stored([1, 2, 3]))
+    idx.apply("podB", _stored([1, 2], medium=MEDIUM_CPU))
+    m = idx.lookup([1, 2, 3, 4], ["podA", "podB", "podC"])
+    assert m["podA"].blocks == 3 and m["podA"].weighted == pytest.approx(3.0)
+    assert m["podB"].blocks == 2 and m["podB"].weighted == pytest.approx(1.6)
+    assert m["podC"].blocks == 0
+    # walk is consecutive-only: a hole stops the match
+    idx.apply("podA", BlockRemoved(block_hashes=[2]))
+    m = idx.lookup([1, 2, 3], ["podA"])
+    assert m["podA"].blocks == 1
+
+
+def test_index_tier_specific_removal():
+    idx = KVBlockIndex()
+    idx.apply("podA", _stored([7]))
+    # CPU-tier removal must not erase the HBM entry
+    idx.apply("podA", BlockRemoved(block_hashes=[7], medium=MEDIUM_CPU))
+    assert idx.lookup([7], ["podA"])["podA"].blocks == 1
+    idx.apply("podA", BlockRemoved(block_hashes=[7], medium=MEDIUM_HBM))
+    assert idx.lookup([7], ["podA"])["podA"].blocks == 0
+
+
+def test_index_clear_and_remove_pod():
+    idx = KVBlockIndex()
+    idx.apply("podA", _stored([1, 2]))
+    idx.apply("podB", _stored([1]))
+    idx.apply("podA", AllBlocksCleared())
+    m = idx.lookup([1, 2], ["podA", "podB"])
+    assert m["podA"].blocks == 0 and m["podB"].blocks == 1
+    idx.remove_pod("podB")
+    assert len(idx) == 0
+
+
+def test_index_speculative_ttl_and_confirmation():
+    idx = KVBlockIndex(speculative_ttl_s=0.05)
+    idx.add_speculative("podA", [10, 11])
+    assert idx.lookup([10, 11], ["podA"])["podA"].blocks == 2
+    # confirmation upgrades: no expiry afterwards
+    idx.apply("podA", _stored([10]))
+    time.sleep(0.08)
+    m = idx.lookup([10, 11], ["podA"])["podA"]
+    assert m.blocks == 1  # 10 confirmed, 11 expired
+    # confirmed entry never downgrades back to speculative
+    idx.add_speculative("podA", [10])
+    time.sleep(0.08)
+    assert idx.lookup([10], ["podA"])["podA"].blocks == 1
+
+
+def test_index_capacity_bounds():
+    idx = KVBlockIndex(max_keys=4, max_pods_per_key=2)
+    for h in range(8):
+        idx.apply("podA", _stored([h]))
+    assert len(idx) == 4  # LRU on keys
+    for p in ("p1", "p2", "p3"):
+        idx.apply(p, _stored([100]))
+    assert len(idx.pods_for_block(100)) == 2  # LRU on pods-per-key
+
+
+# ---------------------------------------------------------------- precise e2e
+PRECISE_CFG = """
+plugins:
+  - {name: token-producer, type: token-producer}
+  - {name: precise-producer, type: precise-prefix-cache-producer, params: {blockSize: 16}}
+  - {name: prefix, type: precise-prefix-cache-scorer}
+  - {name: queue, type: queue-depth-scorer}
+  - {name: inflight, type: inflight-load-producer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: prefix, weight: 3}
+      - {pluginRef: queue, weight: 2}
+"""
+
+
+def test_precise_prefix_routing_end_to_end():
+    async def main():
+        fakes = [FakeModelServer(FakeServerConfig(
+            kv_events_port=0, prefill_us_per_token=5.0, decode_us_per_token=5.0,
+        )) for _ in range(3)]
+        for f in fakes:
+            await f.start()
+        pool = EndpointPool()
+        for f in fakes:
+            pool.upsert(Endpoint(
+                address=f.address,
+                labels={LABEL_KV_EVENTS_ADDR: f"127.0.0.1:{f.cfg.kv_events_port}"},
+            ))
+        cfg = FrameworkConfig.from_yaml(PRECISE_CFG, known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0, poll_interval_s=0.1)
+        await router.start()
+        assert router.kv_subscriber is not None
+        await asyncio.sleep(0.3)  # let SUB connections establish (slow joiner)
+
+        prefix = "shared system prompt " * 10
+        chosen = set()
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://{router.address}/v1/completions",
+                              json={"model": "fake/model", "prompt": prefix + "q0",
+                                    "max_tokens": 4}) as r:
+                assert r.status == 200
+                chosen.add(r.headers["x-llm-d-endpoint"])
+            await asyncio.sleep(0.3)  # engine events land in the index
+            index = router.ctx["kv_index"]
+            assert len(index) > 0, "engine KV events should populate the index"
+            for i in range(1, 5):
+                async with s.post(f"http://{router.address}/v1/completions",
+                                  json={"model": "fake/model", "prompt": prefix + f"q{i}",
+                                        "max_tokens": 4}) as r:
+                    assert r.status == 200
+                    chosen.add(r.headers["x-llm-d-endpoint"])
+        assert len(chosen) == 1, f"shared prefix should stay sticky, got {chosen}"
+
+        await router.stop()
+        for f in fakes:
+            await f.stop()
+
+    run_async(main())
+
+
+def test_pool_removal_cleans_index():
+    async def main():
+        fake = FakeModelServer(FakeServerConfig(kv_events_port=0))
+        await fake.start()
+        pool = EndpointPool()
+        pool.upsert(Endpoint(
+            address=fake.address,
+            labels={LABEL_KV_EVENTS_ADDR: f"127.0.0.1:{fake.cfg.kv_events_port}"},
+        ))
+        cfg = FrameworkConfig.from_yaml(PRECISE_CFG, known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0, poll_interval_s=0.1)
+        await router.start()
+        await asyncio.sleep(0.3)
+        async with aiohttp.ClientSession() as s:
+            await s.post(f"http://{router.address}/v1/completions",
+                         json={"model": "fake/model", "prompt": "x" * 64, "max_tokens": 2})
+        await asyncio.sleep(0.3)
+        index = router.ctx["kv_index"]
+        assert len(index) > 0
+        pool.remove(fake.address)
+        assert len(index) == 0
+        await router.stop()
+        await fake.stop()
+
+    run_async(main())
