@@ -25,8 +25,12 @@ class EngineConfig:
     # Multi-step decode: run N decode iterations in one on-device lax.scan (one host
     # round-trip per N tokens). Stop/max_tokens handled post-hoc by truncation.
     decode_steps: int = 1
-    # KV offload tier (pages of CPU-side cache; 0 = disabled) — K3 equivalent.
+    # KV offload tier (pages of CPU-side cache; 0 = disabled) — K3 equivalent
+    # (TPU_OFFLOAD_NUM_CPU_CHUNKS / STAGING_BLOCKS knobs of the reference connector).
     cpu_offload_pages: int = 0
+    offload_staging_blocks: int = 16
+    # FS tier below the CPU tier (llmd_fs_backend shared_storage_path; None = off).
+    offload_fs_path: "str | None" = None
     # P/D role (disaggregation/README.md roles kv_producer/kv_consumer/both)
     role: str = "both"
 

@@ -53,6 +53,7 @@ class EngineStats:
     total_prefill_tokens: int = 0
     total_decode_tokens: int = 0
     total_preemptions: int = 0
+    total_offload_loads: int = 0  # blocks pulled back from CPU/FS tiers
 
 
 class LLMEngine:
@@ -74,6 +75,18 @@ class LLMEngine:
             enable_prefix_caching=engine_cfg.enable_prefix_caching,
             event_sink=event_sink,
         )
+        self.offload = None
+        if engine_cfg.cpu_offload_pages > 0 or engine_cfg.offload_fs_path:
+            from llmd_tpu.kv.fs_backend import FSKVBackend
+            from llmd_tpu.kv.offload import KVOffloadConnector
+
+            fs = FSKVBackend(engine_cfg.offload_fs_path) if engine_cfg.offload_fs_path else None
+            self.offload = KVOffloadConnector(
+                engine_cfg.cpu_offload_pages,
+                staging_blocks=engine_cfg.offload_staging_blocks,
+                fs_backend=fs, event_sink=event_sink,
+            )
+            self.alloc.evict_hook = lambda h, pid: self.offload.on_evict(self.cache, h, pid)
         self.waiting: deque[Sequence] = deque()
         self.running: list[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
         self.seqs: dict[str, Sequence] = {}
@@ -224,6 +237,10 @@ class LLMEngine:
             # never reuse the whole prompt — the final token's logits must be computed
             max_reuse = max(0, (seq.prompt_len - 1) // ps)
             hit_pages = hit_pages[:max_reuse]
+            # tiered continuation: blocks evicted from HBM may live on in CPU/FS
+            n_offload = 0
+            if self.offload is not None and len(hit_pages) < max_reuse:
+                n_offload = self.offload.match_suffix(keys[len(hit_pages) : max_reuse])
 
             need_new = (min(seq.prompt_len + 1, self.cfg.max_pages_per_seq * ps) + ps - 1) // ps - len(hit_pages)
             # acquire_cached pulls hit pages out of the evictable LRU, so they stop
@@ -249,13 +266,44 @@ class LLMEngine:
                 return  # head-of-line blocks; FCFS admission
             for pid in hit_pages:
                 self.alloc.acquire_cached(pid)
-            seq.pages = list(hit_pages)
-            seq.block_hashes = keys[: len(hit_pages)]
-            seq.num_computed = len(hit_pages) * ps
+            n_hbm = len(hit_pages)
+            off_pages = self._reload_offloaded(seq, keys, n_hbm, n_offload)
+            seq.pages = list(hit_pages) + off_pages
+            seq.block_hashes = keys[: n_hbm + len(off_pages)]
+            seq.num_computed = (n_hbm + len(off_pages)) * ps
             seq.num_cached_prompt = seq.num_computed
             seq.slot = slot
             self.running[slot] = seq
             self.waiting.popleft()
+
+    def _reload_offloaded(self, seq: Sequence, keys: list[int], n_hbm: int,
+                          n_offload: int) -> list[int]:
+        """Pull CPU/FS-tier blocks back into freshly allocated HBM pages and
+        re-index them (they emit BlockStored gpu again — they're resident now)."""
+        if n_offload <= 0:
+            return []
+        ps = self.cfg.page_size
+        off_pids: list[int] = []
+        for _ in range(n_offload):
+            pid = self.alloc.allocate()
+            if pid is None:
+                break
+            off_pids.append(pid)
+        if not off_pids:
+            return []
+        self.cache, n_loaded = self.offload.load_into_cache(
+            self.cache, keys[n_hbm : n_hbm + len(off_pids)], off_pids
+        )
+        for pid in off_pids[n_loaded:]:  # block vanished mid-way (FS evictor race)
+            self.alloc.release(pid)
+        off_pids = off_pids[:n_loaded]
+        for i, pid in enumerate(off_pids):
+            bi = n_hbm + i
+            chunk = seq.token_ids[bi * ps : (bi + 1) * ps]
+            parent = keys[bi - 1] if bi > 0 else None
+            self.alloc.commit_block(pid, keys[bi], chunk, parent, seq.lora_id)
+        self.stats.total_offload_loads += len(off_pids)
+        return off_pids
 
     def _ensure_pages(self, seq: Sequence, upto_tokens: int) -> bool:
         ps = self.cfg.page_size

@@ -50,6 +50,9 @@ class PageAllocator:
         self.enable_prefix_caching = enable_prefix_caching
         self.event_sink = event_sink
         self.medium = medium
+        # Called (block_hash, page_id) just before a cached page is recycled —
+        # the offload connector's HBM→CPU hook (kv/offload.py).
+        self.evict_hook: Optional[Callable[[int, int], None]] = None
         self.free: deque[int] = deque(range(num_pages))
         self.pages: dict[int, PageInfo] = {}
         # block_hash → page_id for complete blocks still resident (any refcount)
@@ -92,6 +95,8 @@ class PageAllocator:
             pid = self.free.popleft()
         elif self.lru:
             h, pid = self.lru.popitem(last=False)
+            if self.evict_hook is not None:
+                self.evict_hook(h, pid)
             del self.cached[h]
             del self.pages[pid]
             self._emit([BlockRemoved(block_hashes=[h], medium=self.medium)])

@@ -27,6 +27,10 @@ def main() -> None:
                     help="bind ZMQ KV-event PUB here (pod-discovery mode)")
     ap.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
     ap.add_argument("--role", default="both", choices=["both", "prefill", "decode"])
+    ap.add_argument("--cpu-offload-pages", type=int, default=0,
+                    help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
+    ap.add_argument("--offload-fs-path", default=None,
+                    help="FS tier below the CPU tier (llmd_fs_backend path)")
     ap.add_argument("--cpu", action="store_true", help="force CPU platform (dev)")
     args = ap.parse_args()
 
@@ -49,7 +53,8 @@ def main() -> None:
         page_size=args.block_size, num_pages=args.num_pages,
         max_model_len=args.max_model_len, max_batch_size=args.max_batch_size,
         prefill_chunk=args.prefill_chunk, decode_steps=args.decode_steps,
-        role=args.role,
+        role=args.role, cpu_offload_pages=args.cpu_offload_pages,
+        offload_fs_path=args.offload_fs_path,
     )
     server = EngineServer(
         model_cfg, engine_cfg,

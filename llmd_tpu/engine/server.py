@@ -247,6 +247,14 @@ class EngineServer:
             f"llmd_tpu:preemptions_total {s.total_preemptions}",
             f"llmd_tpu:requests_total {self.request_count}",
         ]
+        if self.engine.offload is not None:
+            st = self.engine.offload.store
+            lines += [
+                f"llmd_tpu:offload_saves_total {st.saves}",
+                f"llmd_tpu:offload_loads_total {st.loads}",
+                f"llmd_tpu:offload_demotions_total {st.demotions}",
+                f"llmd_tpu:offload_cpu_blocks {len(st)}",
+            ]
         return web.Response(text="\n".join(lines) + "\n")
 
     async def _health(self, request: web.Request):

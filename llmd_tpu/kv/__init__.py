@@ -8,6 +8,10 @@ Re-implements the reference's KV plane (docs/architecture/advanced/kv-management
   pod-discovery delivery, kv-indexer.md:67-87).
 - ``llmd_tpu.kv.plugins``    — router plugins: token-producer,
   precise-prefix-cache-producer, precise-prefix-cache-scorer.
+- ``llmd_tpu.kv.offload``    — TPU offload connector: HBM→CPU tiering
+  (kv-offloader.md:27-118; TPUOffloadConnector analogue).
+- ``llmd_tpu.kv.fs_backend`` — POSIX-FS KV block store (llmd_fs_backend analogue,
+  kv-offloader.md:120-169).
 """
 
 from llmd_tpu.kv.indexer import KVBlockIndex  # noqa: F401

@@ -39,12 +39,19 @@ SPECULATIVE_TTL_S = 2.0  # kv-indexer.md speculative indexing TTL
 
 @dataclass
 class _PodEntry:
-    tier: str = MEDIUM_HBM
-    # 0.0 → confirmed by an engine event; else monotonic expiry of a speculative entry.
-    spec_expiry: float = 0.0
+    """Per-(block, pod) residency. A block can live on SEVERAL tiers of one pod at
+    once (HBM evicted→CPU while still indexed, CPU demoted→FS), so tiers is a map
+    tier → confirmation: 0.0 = confirmed by an engine event, else the monotonic
+    expiry of a speculative entry."""
+
+    tiers: dict[str, float] = field(default_factory=dict)
 
     def live(self, now: float) -> bool:
-        return self.spec_expiry == 0.0 or now < self.spec_expiry
+        return any(exp == 0.0 or now < exp for exp in self.tiers.values())
+
+    def best_weight(self, weights: dict[str, float], now: float) -> float:
+        live = [t for t, exp in self.tiers.items() if exp == 0.0 or now < exp]
+        return max((weights.get(t, 0.0) for t in live), default=0.0)
 
 
 @dataclass
@@ -109,13 +116,15 @@ class KVBlockIndex:
                     if pods is None:
                         continue
                     entry = pods.get(pod)
-                    # Only remove the matching tier: a CPU-tier removal must not
-                    # erase knowledge of an HBM-resident copy.
-                    if entry is not None and entry.tier == event.medium:
-                        del pods[pod]
-                        self._drop(pod, h)
-                        if not pods:
-                            del self._index[h]
+                    # Only remove the matching tier: a gpu-tier removal right after
+                    # an offload's BlockStored(cpu) must keep the CPU-tier entry.
+                    if entry is not None:
+                        entry.tiers.pop(event.medium, None)
+                        if not entry.tiers:
+                            del pods[pod]
+                            self._drop(pod, h)
+                            if not pods:
+                                del self._index[h]
                 self.stats.blocks_removed += len(event.block_hashes)
             elif isinstance(event, AllBlocksCleared):
                 for h in self._pod_keys.pop(pod, ()):
@@ -136,21 +145,13 @@ class KVBlockIndex:
             pods = self._index[block_hash] = OrderedDict()
         existing = pods.get(pod)
         if existing is not None:
-            confirmed_new = spec_expiry == 0.0
-            confirmed_old = existing.spec_expiry == 0.0
-            if confirmed_new and not confirmed_old:
-                # engine event confirms a speculative guess
-                existing.tier, existing.spec_expiry = tier, 0.0
-            elif confirmed_new == confirmed_old:
-                # same confidence class: higher tier wins; refresh speculative TTL
-                if self.tier_weights.get(tier, 0.0) >= self.tier_weights.get(existing.tier, 0.0):
-                    existing.tier = tier
-                if not confirmed_new:
-                    existing.spec_expiry = spec_expiry
-            # else: confirmed entry never downgrades to speculative — keep as is
+            cur = existing.tiers.get(tier)
+            # a confirmed tier entry never downgrades back to speculative
+            if spec_expiry == 0.0 or cur is None or cur != 0.0:
+                existing.tiers[tier] = spec_expiry
             pods.move_to_end(pod)
         else:
-            pods[pod] = _PodEntry(tier=tier, spec_expiry=spec_expiry)
+            pods[pod] = _PodEntry(tiers={tier: spec_expiry})
             self._pod_keys.setdefault(pod, set()).add(block_hash)
             while len(pods) > self.max_pods_per_key:
                 evicted_pod, _ = pods.popitem(last=False)
@@ -170,9 +171,6 @@ class KVBlockIndex:
         expiry = time.monotonic() + self.spec_ttl
         with self._lock:
             for h in block_hashes:
-                pods = self._index.get(h)
-                if pods is not None and (e := pods.get(pod)) is not None and e.spec_expiry == 0.0:
-                    continue  # already confirmed; don't overwrite with speculative
                 self._store(pod, h, tier, spec_expiry=expiry)
             self.stats.speculative_inserts += len(block_hashes)
 
@@ -199,17 +197,20 @@ class KVBlockIndex:
                         continue
                     m = out[p]
                     m.blocks += 1
-                    m.weighted += self.tier_weights.get(e.tier, 0.0)
+                    m.weighted += e.best_weight(self.tier_weights, now)
                     matched_any = True
                 if not matched_any:
                     break
         return out
 
-    def pods_for_block(self, block_hash: int) -> dict[str, str]:
+    def pods_for_block(self, block_hash: int) -> dict[str, list[str]]:
         now = time.monotonic()
         with self._lock:
             pods = self._index.get(block_hash) or {}
-            return {p: e.tier for p, e in pods.items() if e.live(now)}
+            return {
+                p: [t for t, exp in e.tiers.items() if exp == 0.0 or now < exp]
+                for p, e in pods.items() if e.live(now)
+            }
 
     def remove_pod(self, pod: str) -> None:
         """Drop every entry for a departed pod (endpoint removed from the pool)."""

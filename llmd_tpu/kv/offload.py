@@ -1,0 +1,189 @@
+"""KV offload connector: HBM → CPU → FS tiering for the paged cache.
+
+Parity: reference kv-offloader.md:27-118 (native OffloadingConnector: DMA-staged
+GPU→CPU offload with a bounded CPU budget) and the TPU path the reference already
+ships — ``TPUOffloadConnector`` (``tpu_inference.offload.tpu_offload_connector``,
+``kv_role: kv_both``, env ``TPU_OFFLOAD_NUM_CPU_CHUNKS`` / ``STAGING_BLOCKS`` —
+guides/agentic-serving/modelserver/tpu/vllm/patch-vllm.yaml:39,47-50).
+
+TPU-native shape: a KV page lives in the device cache as ``cache[:, :, page_id]``
+(layers-major). Offload is one host transfer of that slice; reload is one batched
+scatter back (``cache.at[:, :, pids].set``) compiled once with a fixed staging width
+so XLA never retraces. Evicted-but-offloaded blocks keep earning prefix-cache hits:
+the engine checks HBM, then CPU, then FS at admission — tiered exactly like the
+reference's gpu→cpu→fs chain, and each transition emits KV events with the right
+``medium`` so the router's tier-weighted scoring stays truthful.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from llmd_tpu.core.kv_events import (
+    BlockRemoved,
+    BlockStored,
+    KVEvent,
+    MEDIUM_CPU,
+    MEDIUM_FS,
+)
+from llmd_tpu.kv.fs_backend import FSKVBackend
+
+
+class CPUOffloadStore:
+    """Bounded host-memory KV block store with LRU demotion to an optional FS tier."""
+
+    def __init__(
+        self,
+        capacity_blocks: int,
+        fs_backend: Optional[FSKVBackend] = None,
+        event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
+    ) -> None:
+        self.capacity = capacity_blocks
+        self.fs = fs_backend
+        self.event_sink = event_sink
+        self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()
+        self._pending_fs: dict[int, object] = {}  # hash → in-flight demotion future
+        self.saves = 0
+        self.loads = 0
+        self.demotions = 0
+
+    def _emit(self, events: list[KVEvent]) -> None:
+        if self.event_sink and events:
+            self.event_sink(events)
+
+    def put(self, block_hash: int, array: np.ndarray) -> None:
+        if block_hash in self._blocks:
+            self._blocks.move_to_end(block_hash)
+            return
+        self._blocks[block_hash] = array
+        self.saves += 1
+        events: list[KVEvent] = [BlockStored(
+            block_hashes=[block_hash], parent_block_hash=None, token_ids=[],
+            block_size=0, medium=MEDIUM_CPU,
+        )]
+        while len(self._blocks) > self.capacity:
+            old_hash, old_arr = self._blocks.popitem(last=False)
+            events.append(BlockRemoved(block_hashes=[old_hash], medium=MEDIUM_CPU))
+            if self.fs is not None:
+                # async demotion: keeps the engine step loop off the disk; the popped
+                # array stays alive in the future's closure until written
+                fut = self.fs.put_async(old_hash, old_arr)
+                self._pending_fs[old_hash] = fut
+                fut.add_done_callback(
+                    lambda _f, h=old_hash: self._pending_fs.pop(h, None)
+                )
+                self.demotions += 1
+                events.append(BlockStored(
+                    block_hashes=[old_hash], parent_block_hash=None, token_ids=[],
+                    block_size=0, medium=MEDIUM_FS,
+                ))
+        self._emit(events)
+
+    def get(self, block_hash: int) -> Optional[np.ndarray]:
+        arr = self._blocks.get(block_hash)
+        if arr is not None:
+            self._blocks.move_to_end(block_hash)
+            self.loads += 1
+            return arr
+        if self.fs is not None:
+            fut = self._pending_fs.get(block_hash)
+            if fut is not None:
+                try:
+                    fut.result()  # wait out an in-flight demotion write
+                except Exception:
+                    return None
+            arr = self.fs.get(block_hash)
+            if arr is not None:
+                self.loads += 1
+                return arr
+        return None
+
+    def contains(self, block_hash: int) -> bool:
+        if block_hash in self._blocks:
+            return True
+        if self.fs is None:
+            return False
+        return block_hash in self._pending_fs or self.fs.contains(block_hash)
+
+    def __len__(self) -> int:
+        return len(self._blocks)
+
+
+class KVOffloadConnector:
+    """Engine-side connector: page eviction hook + batched reload into the cache.
+
+    The engine wires ``on_evict`` into the PageAllocator (called just before a cached
+    page is recycled) and calls ``match``/``load_into_cache`` at admission. Reloads
+    are padded to a fixed ``staging_blocks`` width so the jitted scatter compiles
+    once (STAGING_BLOCKS knob of the reference TPU connector).
+    """
+
+    def __init__(
+        self,
+        num_cpu_chunks: int,
+        staging_blocks: int = 16,
+        fs_backend: Optional[FSKVBackend] = None,
+        event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
+    ) -> None:
+        self.store = CPUOffloadStore(num_cpu_chunks, fs_backend, event_sink)
+        self.staging_blocks = max(1, staging_blocks)
+        self._load_fn = None  # jitted, built lazily (needs cache shape)
+
+    # ------------------------------------------------------------------ evict
+    def on_evict(self, cache, block_hash: int, page_id: int) -> None:
+        """Copy an about-to-be-recycled page HBM→host (one device-to-host transfer)."""
+        self.store.put(block_hash, np.asarray(cache[:, :, page_id]))
+
+    # ------------------------------------------------------------------ match
+    def match_suffix(self, block_hashes: list[int]) -> int:
+        """How many consecutive leading blocks the offload tiers hold."""
+        n = 0
+        for h in block_hashes:
+            if not self.store.contains(h):
+                break
+            n += 1
+        return n
+
+    # ------------------------------------------------------------------ reload
+    def load_into_cache(self, cache, block_hashes: list[int], page_ids: list[int]):
+        """Scatter offloaded blocks back into freshly allocated pages.
+
+        Returns (new_cache, n_loaded) — n_loaded may stop short if a block vanished
+        (FS evictor raced us); callers recompute the remainder.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        if self._load_fn is None:
+            P = cache.shape[2]
+
+            def _load(cache, blocks, pids):
+                # pids -1 → out-of-bounds index dropped by the scatter (padding)
+                idx = jnp.where(pids >= 0, pids, P)
+                return cache.at[:, :, idx].set(
+                    jnp.moveaxis(blocks, 0, 2).astype(cache.dtype), mode="drop"
+                )
+
+            self._load_fn = jax.jit(_load, donate_argnums=(0,))
+
+        arrays: list[np.ndarray] = []
+        for h in block_hashes:
+            arr = self.store.get(h)
+            if arr is None:
+                break
+            arrays.append(arr)
+        n_loaded = len(arrays)
+        S = self.staging_blocks
+        block_shape = cache.shape[:2] + cache.shape[3:]  # [L, 2, ps, Hk, Dh]
+        for start in range(0, n_loaded, S):
+            group = arrays[start : start + S]
+            pids = np.full((S,), -1, np.int32)
+            pids[: len(group)] = page_ids[start : start + len(group)]
+            stacked = np.zeros((S,) + block_shape, dtype=group[0].dtype)
+            for i, a in enumerate(group):
+                stacked[i] = a
+            cache = self._load_fn(cache, stacked, pids)
+        return cache, n_loaded

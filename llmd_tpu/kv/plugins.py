@@ -41,6 +41,7 @@ class TokenProducer(DataProducer):
         self.render_calls = 0
         self.render_errors = 0
         self._last_good: Optional[str] = None  # avoid re-paying a dead endpoint's timeout
+        self._cooldown_until = 0.0  # negative cache: all endpoints failed recently
 
     async def aproduce(self, req: InferenceRequest, endpoints: list[Endpoint],
                        session: aiohttp.ClientSession) -> None:
@@ -55,6 +56,10 @@ class TokenProducer(DataProducer):
             body["messages"] = req.messages
         else:
             body["prompt"] = req.prompt or ""
+        import time
+
+        if time.monotonic() < self._cooldown_until:
+            return  # every endpoint failed recently; fall back to byte-level tokens
         ordered = sorted(endpoints, key=lambda e: e.address != self._last_good)
         for ep in ordered:
             try:
@@ -73,6 +78,8 @@ class TokenProducer(DataProducer):
                 if ep.address == self._last_good:
                     self._last_good = None
                 continue
+        if endpoints:
+            self._cooldown_until = time.monotonic() + 2.0
 
     def produce(self, req: InferenceRequest, endpoints: list[Endpoint]) -> None:
         if STATE_TOKEN_IDS not in req.state:

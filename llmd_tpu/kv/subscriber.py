@@ -53,6 +53,7 @@ class KVEventSubscriberManager:
         self._tasks: dict[str, asyncio.Task] = {}
         self._central_task: Optional[asyncio.Task] = None
         self._last_seq: dict[str, int] = {}
+        self._stopping = False
         self.seq_gaps = 0
         self.batches_received = 0
 
@@ -69,6 +70,7 @@ class KVEventSubscriberManager:
                 self._maybe_subscribe(ep)
 
     async def stop(self) -> None:
+        self._stopping = True
         if self.pool is not None:
             self.pool.unsubscribe(self._on_pool_event)
         for t in list(self._tasks.values()) + ([self._central_task] if self._central_task else []):
@@ -118,11 +120,15 @@ class KVEventSubscriberManager:
         else:
             # pool callbacks may fire from a discovery thread (k8s watch); hop onto
             # the subscriber's loop — create_task is not thread-safe.
-            def _spawn(address: str = ep.address, z: str = zaddr) -> None:
-                if address not in self._tasks and self._zctx is not None:
-                    self._tasks[address] = self._loop.create_task(self._run_pod(address, z))
+            loop = self._loop
 
-            self._loop.call_soon_threadsafe(_spawn)
+            def _spawn(address: str = ep.address, z: str = zaddr) -> None:
+                # guard against stop() racing the hop: _stopping flips before
+                # tasks are cancelled, so nothing spawns after that point
+                if address not in self._tasks and not self._stopping and self._zctx is not None:
+                    self._tasks[address] = loop.create_task(self._run_pod(address, z))
+
+            loop.call_soon_threadsafe(_spawn)
 
     def subscribe_pod(self, pod_address: str, zmq_address: str) -> None:
         """Explicit subscription (tests / static wiring)."""

@@ -58,6 +58,21 @@ def test_index_tier_specific_removal():
     assert idx.lookup([7], ["podA"])["podA"].blocks == 0
 
 
+def test_index_offload_event_sequence_keeps_cpu_tier():
+    """HBM→CPU offload emits BlockStored(cpu) then BlockRemoved(gpu) — the index
+    must keep the CPU-tier entry (two-tier residency per (block, pod))."""
+    idx = KVBlockIndex()
+    idx.apply("podA", _stored([5]))  # gpu
+    idx.apply("podA", _stored([5], medium=MEDIUM_CPU))  # offload copy
+    m = idx.lookup([5], ["podA"])["podA"]
+    assert m.blocks == 1 and m.weighted == pytest.approx(1.0)  # best tier = gpu
+    idx.apply("podA", BlockRemoved(block_hashes=[5], medium=MEDIUM_HBM))
+    m = idx.lookup([5], ["podA"])["podA"]
+    assert m.blocks == 1 and m.weighted == pytest.approx(0.8)  # cpu copy survives
+    idx.apply("podA", BlockRemoved(block_hashes=[5], medium=MEDIUM_CPU))
+    assert idx.lookup([5], ["podA"])["podA"].blocks == 0
+
+
 def test_index_clear_and_remove_pod():
     idx = KVBlockIndex()
     idx.apply("podA", _stored([1, 2]))
