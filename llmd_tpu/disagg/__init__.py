@@ -1,0 +1,25 @@
+"""P/D disaggregation: KV-transfer engine + routing sidecar.
+
+Parity: reference docs/architecture/advanced/disaggregation/README.md — the routing
+sidecar (104-131) and the KV transfer layer (133-178, NIXL on GPU; TPUConnectorHMA's
+host-memory-assisted TCP path on TPU). Ours is the TPU-native design: device→host
+contiguous staging, pull-model side channel, recompute-on-failure.
+"""
+
+from llmd_tpu.disagg.transfer import (
+    KVTransferClient,
+    KVTransferParams,
+    KVTransferSource,
+    extract_blocks,
+    insert_blocks,
+)
+from llmd_tpu.disagg.sidecar import RoutingSidecar
+
+__all__ = [
+    "KVTransferClient",
+    "KVTransferParams",
+    "KVTransferSource",
+    "RoutingSidecar",
+    "extract_blocks",
+    "insert_blocks",
+]

@@ -1,0 +1,160 @@
+"""Routing sidecar: per-decode-pod proxy orchestrating the P→D multi-step flow.
+
+Parity: reference docs/architecture/advanced/disaggregation/README.md:104-131 and the
+deployment shape in recipes/modelserver/base/single-host/pd/vllm/patch-sidecar.yaml —
+the sidecar listens on the pod's serving port in front of the local decode engine,
+reads the router's ``x-prefiller-host-port`` header, and:
+
+1. sends the request to the prefiller with ``max_tokens=1`` + kv_transfer_params
+   ``{do_remote_decode: true}`` (sampling disabled unless ``enable_prefiller_sampling``),
+2. captures the returned transfer handle from the prefill response,
+3. injects it (``do_remote_prefill``) into the original request and forwards it to the
+   local decode engine, streaming the response straight through,
+4. falls back to decoder-only (aggregated) when the prefiller fails with 5xx or is
+   unreachable (README.md:130).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.core.request import HDR_PREFILLER_HOST_PORT
+
+GEN_PATHS = ("/v1/completions", "/v1/chat/completions")
+
+
+class RoutingSidecar:
+    def __init__(
+        self,
+        decode_addr: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        enable_prefiller_sampling: bool = False,
+        prefill_timeout_s: float = 120.0,
+    ) -> None:
+        self.decode_addr = decode_addr
+        self.host, self.port = host, port
+        self.enable_prefiller_sampling = enable_prefiller_sampling
+        self.prefill_timeout = aiohttp.ClientTimeout(total=prefill_timeout_s)
+        self._runner: Optional[web.AppRunner] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.stats = {"pd_requests": 0, "aggregated_requests": 0, "prefill_fallbacks": 0}
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        app = web.Application(client_max_size=32 * 1024 * 1024)
+        for path in GEN_PATHS:
+            app.router.add_post(path, self._generate)
+        app.router.add_route("*", "/{tail:.*}", self._passthrough)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._session:
+            await self._session.close()
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------------
+    async def _generate(self, request: web.Request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+
+        prefiller = request.headers.get(HDR_PREFILLER_HOST_PORT)
+        if prefiller:
+            ktp = await self._run_prefill(request.path, body, prefiller)
+            if ktp is not None:
+                body = dict(body)
+                body["kv_transfer_params"] = {"do_remote_prefill": True, **ktp}
+                self.stats["pd_requests"] += 1
+            else:
+                self.stats["prefill_fallbacks"] += 1
+        else:
+            self.stats["aggregated_requests"] += 1
+        return await self._forward_decode(request, body)
+
+    async def _run_prefill(self, path: str, body: dict, prefiller: str) -> Optional[dict]:
+        """Phase 1: remote prefill. Returns the transfer handle, or None → fallback."""
+        pbody = copy.deepcopy(body)
+        pbody["max_tokens"] = 1
+        pbody["stream"] = False
+        pbody["kv_transfer_params"] = {"do_remote_decode": True}
+        if not self.enable_prefiller_sampling:
+            pbody["temperature"] = 0.0
+        try:
+            async with self._session.post(
+                f"http://{prefiller}{path}", json=pbody, timeout=self.prefill_timeout
+            ) as resp:
+                if resp.status >= 500:
+                    return None
+                data = await resp.json()
+        except (aiohttp.ClientError, asyncio.TimeoutError, json.JSONDecodeError, OSError):
+            return None
+        ktp = data.get("kv_transfer_params")
+        if not ktp or not ktp.get("remote_request_id"):
+            return None
+        if not ktp.get("remote_host"):
+            ktp["remote_host"] = prefiller.rsplit(":", 1)[0]
+        return ktp
+
+    async def _forward_decode(self, request: web.Request, body: dict):
+        """Phase 2: forward to the local decode engine, streaming straight through."""
+        try:
+            async with self._session.post(
+                f"http://{self.decode_addr}{request.path}", json=body,
+                timeout=aiohttp.ClientTimeout(total=None),
+            ) as upstream:
+                if not body.get("stream"):
+                    payload = await upstream.read()
+                    return web.Response(
+                        body=payload, status=upstream.status,
+                        content_type=upstream.content_type,
+                    )
+                resp = web.StreamResponse(status=upstream.status, headers={
+                    "Content-Type": upstream.headers.get("Content-Type", "text/event-stream"),
+                    "Cache-Control": "no-cache",
+                })
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except (aiohttp.ClientError, OSError) as e:
+            return web.json_response(
+                {"error": {"message": f"decode engine unreachable: {e}"}}, status=502
+            )
+
+    async def _passthrough(self, request: web.Request):
+        """Non-generate traffic (health, models, metrics) proxied to the engine."""
+        try:
+            data = await request.read()
+            async with self._session.request(
+                request.method, f"http://{self.decode_addr}{request.path_qs}",
+                data=data or None,
+                headers={k: v for k, v in request.headers.items()
+                         if k.lower() not in ("host", "content-length")},
+            ) as upstream:
+                payload = await upstream.read()
+                return web.Response(
+                    body=payload, status=upstream.status,
+                    content_type=upstream.content_type,
+                )
+        except (aiohttp.ClientError, OSError) as e:
+            return web.json_response(
+                {"error": {"message": f"decode engine unreachable: {e}"}}, status=502
+            )

@@ -1,0 +1,442 @@
+"""KV-transfer engine: pull-model block movement between prefill and decode pods.
+
+Plays NIXL's role on the reference GPU path (disaggregation/README.md:133-178) the way
+the reference's own TPU connector does it — host-memory-assisted (`TPUConnectorHMA`,
+guides/pd-disaggregation/modelserver/tpu/base/vllm/patch-prefill.yaml:17-27: KV port
+9100, side channel 9600) — because XLA owns HBM and one-sided device reads into live
+buffers are not expressible; instead:
+
+- **prefill (producer)**: after prefill completes, the request's complete KV blocks are
+  gathered device→host into ONE contiguous staging buffer (the contiguous-layout trick
+  the reference's offloader uses for 4-5× transfer throughput, kv-offloader.md:33-40)
+  and registered under the request id,
+- **decode (consumer)**: pulls blocks over a TCP side channel (pull model ≙ NIXL's
+  one-sided read: decode fetches when ready, prefill stays passive), verifies the
+  chained block hashes, writes host→device, and commits the blocks into its local
+  prefix cache — so admission reuses them exactly like local prefix hits, and any
+  failure (connection refused, hash mismatch, pool pressure) degrades to recompute
+  (`kv_load_failure_policy=recompute`, operations-vllm.md:84-100),
+- **release**: decode's post-injection notify frees producer-side blocks (the NIXL
+  notify semantics, operations-vllm.md:48-60); a TTL reaper frees abandoned exports
+  (decode died mid-transfer).
+
+The framed wire protocol is implementation-neutral; the C++ data plane
+(csrc/kv_transfer.cpp, built via llmd_tpu.native) serves the same protocol for the
+byte-moving hot path with the Python implementation as fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+MAGIC = b"KVT1"
+
+
+# ---------------------------------------------------------------------------
+# Device↔host block staging
+# ---------------------------------------------------------------------------
+
+
+def extract_blocks(cache, page_ids: list[int]) -> np.ndarray:
+    """Gather pages from the device cache into one contiguous host buffer.
+
+    cache: [L, 2, P, ps, Hk, Dh] → returns [n, L, 2, ps, Hk, Dh] (block-major so each
+    block is a contiguous byte range — streamable/sliceable without repacking).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    sub = cache[:, :, jnp.asarray(np.asarray(page_ids, np.int32))]
+    arr = np.asarray(jax.device_get(sub))  # [L, 2, n, ps, Hk, Dh]
+    return np.ascontiguousarray(np.moveaxis(arr, 2, 0))
+
+
+def insert_blocks(cache, page_ids: list[int], blocks: np.ndarray):
+    """Write pulled blocks ([n, L, 2, ps, Hk, Dh]) into device pages; returns new cache."""
+    import jax.numpy as jnp
+
+    dev = jnp.asarray(np.moveaxis(blocks, 0, 2)).astype(cache.dtype)
+    return cache.at[:, :, jnp.asarray(np.asarray(page_ids, np.int32))].set(dev)
+
+
+# ---------------------------------------------------------------------------
+# Transfer params (the vLLM kv_transfer_params analogue, JSON-serializable)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class KVTransferParams:
+    """Carried in request/response bodies between sidecar, P and D engines."""
+
+    do_remote_decode: bool = False  # request to P: keep KV, return transfer handle
+    do_remote_prefill: bool = False  # request to D: pull KV before compute
+    remote_host: Optional[str] = None
+    remote_port: Optional[int] = None
+    remote_request_id: Optional[str] = None
+    num_blocks: int = 0
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "KVTransferParams":
+        d = d or {}
+        return cls(
+            do_remote_decode=bool(d.get("do_remote_decode")),
+            do_remote_prefill=bool(d.get("do_remote_prefill")),
+            remote_host=d.get("remote_host"),
+            remote_port=d.get("remote_port"),
+            remote_request_id=d.get("remote_request_id"),
+            num_blocks=int(d.get("num_blocks", 0)),
+        )
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if v not in (None, False, 0)}
+
+
+# ---------------------------------------------------------------------------
+# Producer side: exported-block registry + side-channel server
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ExportedKV:
+    block_hashes: list[int]
+    token_chunks: list[list[int]]
+    payload: bytes  # contiguous staging buffer (n blocks back-to-back)
+    dtype: str
+    block_shape: tuple[int, ...]  # [L, 2, ps, Hk, Dh]
+    created: float = field(default_factory=time.monotonic)
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def _pull_header(block_hashes, token_chunks, dtype: str, block_shape, nbytes: int) -> bytes:
+    """The pull-response header — ONE composer shared by both transports."""
+    return json.dumps({
+        "found": True, "block_hashes": list(block_hashes),
+        "token_chunks": [list(c) for c in token_chunks], "dtype": dtype,
+        "block_shape": list(block_shape), "nbytes": nbytes,
+    }).encode()
+
+
+class KVTransferSource:
+    """Prefill-side export registry + TCP pull server.
+
+    Protocol (shared by both transports):
+      request:  MAGIC ‖ u32 len ‖ JSON {"op": "pull"|"notify", "id": str}
+      response: u32 len ‖ JSON header ‖ payload[header["nbytes"]]
+
+    ``transport``: "native" = C++ data plane (csrc/kv_transfer.cpp — serving runs off
+    the GIL, the NIXL-role component), "python" = threaded sockets, "auto" = native
+    with Python fallback.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0, ttl_s: float = 120.0,
+                 transport: str = "auto") -> None:
+        self.host, self.port = host, port
+        self.ttl_s = ttl_s  # outlives the sidecar idle window (tpu patch keep-alive 120s)
+        self.transport = transport
+        self.native = None  # (lib, handle) when the C++ server is live
+        self.exports: dict[str, ExportedKV] = {}
+        self._lock = threading.Lock()
+        self._srv: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._stats = {"exports": 0, "pulls": 0, "notifies": 0, "expired": 0, "misses": 0}
+
+    @property
+    def stats(self) -> dict[str, int]:
+        if self.native is not None:
+            lib, h = self.native
+            return {k: int(lib.kvt_stat(h, k.encode()))
+                    for k in ("exports", "pulls", "notifies", "expired", "misses")}
+        return self._stats
+
+    # -- registry ----------------------------------------------------------
+    def register(self, request_id: str, block_hashes: list[int],
+                 token_chunks: list[list[int]], blocks: np.ndarray) -> int:
+        payload = blocks.tobytes()
+        if self.native is not None:
+            lib, h = self.native
+            hdr = _pull_header(block_hashes, token_chunks, str(blocks.dtype),
+                               blocks.shape[1:], len(payload))
+            lib.kvt_register(h, request_id.encode(), hdr, len(hdr), payload, len(payload))
+            return len(payload)
+        ex = ExportedKV(
+            block_hashes=list(block_hashes),
+            token_chunks=[list(c) for c in token_chunks],
+            payload=payload,
+            dtype=str(blocks.dtype),
+            block_shape=tuple(blocks.shape[1:]),
+        )
+        with self._lock:
+            self.exports[request_id] = ex
+            self._stats["exports"] += 1
+        return len(ex.payload)
+
+    def release(self, request_id: str) -> None:
+        if self.native is not None:
+            lib, h = self.native
+            lib.kvt_release(h, request_id.encode())
+            return
+        with self._lock:
+            self.exports.pop(request_id, None)
+
+    def __len__(self) -> int:
+        if self.native is not None:
+            lib, h = self.native
+            return int(lib.kvt_count(h))
+        with self._lock:
+            return len(self.exports)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self.transport in ("auto", "native") and self._start_native():
+            return
+        if self.transport == "native":
+            raise RuntimeError("native kv_transfer transport unavailable (g++ build failed)")
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((self.host, self.port))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self._srv.settimeout(0.25)
+        t = threading.Thread(target=self._accept_loop, name="kvt-accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        r = threading.Thread(target=self._reaper, name="kvt-reaper", daemon=True)
+        r.start()
+        self._threads.append(r)
+
+    def _start_native(self) -> bool:
+        import ctypes
+
+        from llmd_tpu.native import load_library
+
+        lib = load_library("kv_transfer")
+        if lib is None:
+            return False
+        lib.kvt_server_create.restype = ctypes.c_void_p
+        lib.kvt_server_create.argtypes = [ctypes.c_int]
+        lib.kvt_server_port.argtypes = [ctypes.c_void_p]
+        lib.kvt_register.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_long,
+        ]
+        lib.kvt_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kvt_count.argtypes = [ctypes.c_void_p]
+        lib.kvt_reap.argtypes = [ctypes.c_void_p, ctypes.c_double]
+        lib.kvt_stat.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.kvt_stat.restype = ctypes.c_long
+        lib.kvt_server_destroy.argtypes = [ctypes.c_void_p]
+        h = lib.kvt_server_create(self.port)
+        if not h:
+            return False
+        self.native = (lib, h)
+        self.port = int(lib.kvt_server_port(h))
+        r = threading.Thread(target=self._native_reaper, name="kvt-reaper", daemon=True)
+        r.start()
+        self._threads.append(r)
+        return True
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.native is not None:
+            lib, h = self.native
+            self.native = None
+            lib.kvt_server_destroy(h)
+        if self._srv is not None:
+            self._srv.close()
+
+    def _native_reaper(self) -> None:
+        while not self._stop.wait(min(5.0, self.ttl_s / 4)):
+            if self.native is None:
+                return
+            lib, h = self.native
+            lib.kvt_reap(h, self.ttl_s)
+
+    def _reaper(self) -> None:
+        while not self._stop.wait(min(5.0, self.ttl_s / 4)):
+            cutoff = time.monotonic() - self.ttl_s
+            with self._lock:
+                dead = [rid for rid, ex in self.exports.items() if ex.created < cutoff]
+                for rid in dead:
+                    del self.exports[rid]
+                    self._stats["expired"] += 1
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,), daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(30.0)
+                # one connection may carry several requests (handshake reuse)
+                while not self._stop.is_set():
+                    try:
+                        magic = _recv_exact(conn, 4)
+                    except ConnectionError:
+                        return
+                    if magic != MAGIC:
+                        return
+                    (ln,) = struct.unpack(">I", _recv_exact(conn, 4))
+                    req = json.loads(_recv_exact(conn, ln))
+                    self._handle(conn, req)
+        except Exception:
+            pass  # connection-scoped failure; peer retries or recomputes
+
+    def _handle(self, conn: socket.socket, req: dict) -> None:
+        op, rid = req.get("op"), req.get("id", "")
+        if op == "pull":
+            with self._lock:
+                ex = self.exports.get(rid)
+                self._stats["pulls" if ex else "misses"] += 1
+            if ex is None:
+                hdr = json.dumps({"found": False, "nbytes": 0}).encode()
+                conn.sendall(struct.pack(">I", len(hdr)) + hdr)
+                return
+            hdr = _pull_header(ex.block_hashes, ex.token_chunks, ex.dtype,
+                               ex.block_shape, len(ex.payload))
+            conn.sendall(struct.pack(">I", len(hdr)) + hdr)
+            conn.sendall(ex.payload)
+        elif op == "notify":
+            with self._lock:
+                self.exports.pop(rid, None)
+                self._stats["notifies"] += 1
+            hdr = json.dumps({"ok": True, "nbytes": 0}).encode()
+            conn.sendall(struct.pack(">I", len(hdr)) + hdr)
+
+
+# ---------------------------------------------------------------------------
+# Consumer side
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PulledKV:
+    block_hashes: list[int]
+    token_chunks: list[list[int]]
+    blocks: np.ndarray  # [n, L, 2, ps, Hk, Dh]
+
+
+class KVTransferClient:
+    """Decode-side puller (blocking; callers run it in an executor thread)."""
+
+    def __init__(self, timeout_s: float = 30.0) -> None:
+        self.timeout_s = timeout_s
+
+    def _request(self, host: str, port: int, req: dict) -> tuple[dict, bytes]:
+        with socket.create_connection((host, port), timeout=self.timeout_s) as conn:
+            body = json.dumps(req).encode()
+            conn.sendall(MAGIC + struct.pack(">I", len(body)) + body)
+            (ln,) = struct.unpack(">I", _recv_exact(conn, 4))
+            hdr = json.loads(_recv_exact(conn, ln))
+            payload = _recv_exact(conn, hdr.get("nbytes", 0)) if hdr.get("nbytes") else b""
+            return hdr, payload
+
+    def pull(self, host: str, port: int, request_id: str) -> Optional[PulledKV]:
+        hdr, payload = self._request(host, port, {"op": "pull", "id": request_id})
+        if not hdr.get("found"):
+            return None
+        shape = tuple(hdr["block_shape"])
+        n = len(hdr["block_hashes"])
+        blocks = np.frombuffer(payload, dtype=np.dtype(hdr["dtype"])).reshape((n,) + shape)
+        return PulledKV(hdr["block_hashes"], hdr["token_chunks"], blocks)
+
+    def notify(self, host: str, port: int, request_id: str) -> bool:
+        try:
+            hdr, _ = self._request(host, port, {"op": "notify", "id": request_id})
+            return bool(hdr.get("ok"))
+        except OSError:
+            return False  # producer gone; its TTL reaper cleans up
+
+
+# ---------------------------------------------------------------------------
+# Engine-side connector glue
+# ---------------------------------------------------------------------------
+
+
+def export_from_engine(engine, source: KVTransferSource, request_id: str,
+                       token_ids: list[int], lora_id: Optional[str] = None) -> KVTransferParams:
+    """Export a finished prefill request's resident KV blocks (caller holds the
+    engine lock — the step loop must not evict pages mid-gather)."""
+    from llmd_tpu.core.kv_events import block_keys_for_tokens
+
+    ps = engine.cfg.page_size
+    keys = block_keys_for_tokens(token_ids, ps, lora_id)
+    pids: list[int] = []
+    hashes: list[int] = []
+    chunks: list[list[int]] = []
+    for i, h in enumerate(keys):
+        pid = engine.alloc.cached.get(h)
+        if pid is None:
+            break  # chain broken (block evicted already) — export the resident prefix
+        pids.append(pid)
+        hashes.append(h)
+        chunks.append(token_ids[i * ps : (i + 1) * ps])
+    if pids:
+        blocks = extract_blocks(engine.cache, pids)
+        source.register(request_id, hashes, chunks, blocks)
+    return KVTransferParams(
+        remote_request_id=request_id, num_blocks=len(pids),
+    )
+
+
+def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
+                       lora_id: Optional[str] = None) -> int:
+    """Commit pulled blocks into the local allocator + cache as prefix-cache entries
+    (caller holds the engine lock). Returns blocks injected.
+
+    Hash-chain verification: only blocks matching the locally recomputed chain for
+    THIS prompt are accepted — a stale/foreign export cannot poison the cache.
+    """
+    from llmd_tpu.core.kv_events import block_keys_for_tokens
+
+    ps = engine.cfg.page_size
+    keys = block_keys_for_tokens(token_ids, ps, lora_id)
+    take: list[tuple[int, int]] = []  # (pulled_idx, page_id)
+    parent_of: dict[int, Optional[int]] = {}
+    parent: Optional[int] = None
+    for i, h in enumerate(pulled.block_hashes):
+        if i >= len(keys) or keys[i] != h:
+            break
+        parent_of[h] = parent
+        parent = h
+        if h in engine.alloc.cached:
+            continue  # already resident locally
+        pid = engine.alloc.allocate()
+        if pid is None:
+            break  # pool pressure: keep what we have, recompute the rest
+        take.append((i, pid))
+    if not take:
+        return 0
+    idxs = [i for i, _ in take]
+    pids = [p for _, p in take]
+    engine.cache = insert_blocks(engine.cache, pids, pulled.blocks[idxs])
+    for i, pid in take:
+        h = pulled.block_hashes[i]
+        engine.alloc.commit_block(pid, h, pulled.token_chunks[i], parent_of[h], lora_id)
+        engine.alloc.release(pid)  # refcount 0 → cached/evictable, like any prefix hit
+    return len(take)

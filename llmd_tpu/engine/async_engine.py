@@ -82,3 +82,9 @@ class AsyncLLMEngine:
 
     def stats(self):
         return self.engine.stats
+
+    def run_locked(self, fn):
+        """Run fn() while the step loop is paused — for callers that must mutate
+        engine state (KV injection/export) without racing a step in flight."""
+        with self._lock:
+            return fn()

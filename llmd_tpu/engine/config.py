@@ -29,6 +29,10 @@ class EngineConfig:
     # (TPU_OFFLOAD_NUM_CPU_CHUNKS / STAGING_BLOCKS knobs of the reference connector).
     cpu_offload_pages: int = 0
     offload_staging_blocks: int = 16
+    # Proactive drain: when the plain free list falls below this, demote the oldest
+    # LRU pages to the CPU tier in one batched gather (keeps per-page D2H syncs off
+    # the allocate() hot path).
+    offload_watermark_pages: int = 8
     # FS tier below the CPU tier (llmd_fs_backend shared_storage_path; None = off).
     offload_fs_path: "str | None" = None
     # P/D role (disaggregation/README.md roles kv_producer/kv_consumer/both)

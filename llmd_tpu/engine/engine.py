@@ -335,6 +335,8 @@ class LLMEngine:
     def step(self) -> list[EngineOutput]:
         """One engine iteration: admit → one prefill chunk (if any) → one decode batch."""
         self._outputs = []
+        if self.offload is not None:
+            self._offload_drain()
         self._try_admit()
         self._step_prefill()
         self._step_decode()
@@ -342,6 +344,17 @@ class LLMEngine:
         self.stats.num_running = sum(1 for s in self.running if s is not None)
         self.stats.kv_utilization = self.alloc.utilization()
         return self._outputs
+
+    def _offload_drain(self) -> None:
+        """Keep the plain free list above the watermark by batch-demoting the oldest
+        LRU pages (one gather per step) — evictions then rarely hit the per-page
+        on_evict backstop inside allocate()."""
+        need = self.cfg.offload_watermark_pages - len(self.alloc.free)
+        if need <= 0 or not self.alloc.lru:
+            return
+        n = min(need, self.cfg.offload_staging_blocks, len(self.alloc.lru))
+        pairs = self.alloc.demote_lru(n)
+        self.offload.demote_batch(self.cache, pairs)
 
     def _prefill_target(self, seq: Sequence) -> int:
         """Tokens that must be processed chunk-wise before decode can take over.

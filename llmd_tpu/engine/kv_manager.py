@@ -156,6 +156,23 @@ class PageAllocator:
             del self.pages[page_id]
             self.free.append(page_id)
 
+    def demote_lru(self, n: int) -> list[tuple[int, int]]:
+        """Pop the n oldest evictable cached pages onto the free list and return
+        their (block_hash, page_id) pairs — the offload connector's batched-drain
+        entry (one D2H gather for the whole batch instead of per-page syncs in
+        allocate()). The evict_hook is NOT called; the caller owns the copy-out,
+        which is safe until the freed pages are reallocated AND rewritten."""
+        pairs: list[tuple[int, int]] = []
+        while self.lru and len(pairs) < n:
+            h, pid = self.lru.popitem(last=False)
+            pairs.append((h, pid))
+            del self.cached[h]
+            del self.pages[pid]
+            self.free.append(pid)
+        if pairs:
+            self._emit([BlockRemoved(block_hashes=[h for h, _ in pairs], medium=self.medium)])
+        return pairs
+
     def clear(self) -> None:
         self.free = deque(range(self.num_pages))
         self.pages.clear()

@@ -25,6 +25,12 @@ def main() -> None:
     ap.add_argument("--decode-steps", type=int, default=4)
     ap.add_argument("--kv-events-port", type=int, default=None,
                     help="bind ZMQ KV-event PUB here (pod-discovery mode)")
+    ap.add_argument("--kv-transfer-port", type=int, default=None,
+                    help="bind the P/D KV-transfer side channel here (0 = random; "
+                         "TPU_KV_TRANSFER_PORT analogue, reference default 9100)")
+    ap.add_argument("--advertise-host", default=None,
+                    help="routable host for kv_transfer_params (defaults to --host "
+                         "unless that is a bind-any address)")
     ap.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
     ap.add_argument("--role", default="both", choices=["both", "prefill", "decode"])
     ap.add_argument("--cpu-offload-pages", type=int, default=0,
@@ -60,8 +66,11 @@ def main() -> None:
         model_cfg, engine_cfg,
         model_name=args.served_model_name or f"llmd-tpu/{args.model}",
         host=args.host, port=args.port, kv_events_port=args.kv_events_port,
+        kv_transfer_port=args.kv_transfer_port,
         tokenizer=load_tokenizer(args.tokenizer),
     )
+    if args.advertise_host:
+        server.advertise_host = args.advertise_host
 
     async def run() -> None:
         await server.start()

@@ -6,6 +6,12 @@ endpoints for the router's token-producer (kv-indexer.md:104-113), Prometheus /m
 with vLLM-compatible names (:38-52), /health probes (:81-86), and ZMQ KV-event
 publishing in pod-discovery mode (kv-indexer.md:67-87).
 
+P/D disaggregation (disaggregation/README.md): with ``kv_transfer_port`` set, the
+server exposes the KV-transfer side channel — requests carrying
+``kv_transfer_params.do_remote_decode`` export their prefill KV for remote pull;
+requests carrying ``do_remote_prefill`` pull + inject remote KV before compute
+(falling back to recompute on any failure).
+
 Run: python -m llmd_tpu.engine.serve --model tiny --port 8000
 """
 
@@ -21,6 +27,11 @@ from aiohttp import web
 
 from llmd_tpu.core.kv_events import KVEvent, encode_event_batch, kv_topic
 from llmd_tpu.core.request import SamplingParams, flatten_messages
+from llmd_tpu.disagg.transfer import (
+    KVTransferParams,
+    export_from_engine,
+    inject_into_engine,
+)
 from llmd_tpu.engine.async_engine import AsyncLLMEngine
 from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.engine import LLMEngine
@@ -52,6 +63,7 @@ class EngineServer:
         host: str = "127.0.0.1",
         port: int = 8000,
         kv_events_port: Optional[int] = None,
+        kv_transfer_port: Optional[int] = None,
         tokenizer: Optional[Tokenizer] = None,
         params=None,
     ) -> None:
@@ -59,6 +71,11 @@ class EngineServer:
         self.host, self.port = host, port
         self.tokenizer = tokenizer or load_tokenizer()
         self.kv_events_port = kv_events_port
+        self.kv_transfer_port = kv_transfer_port
+        self.advertise_host: Optional[str] = None  # routable host for transfer handles
+        self.transfer_source = None
+        self.transfer_client = None
+        self.transfer_stats = {"injected_blocks": 0, "pull_failures": 0}
         self._zctx = None
         self._pub = None
         self._kv_seq = 0
@@ -103,6 +120,13 @@ class EngineServer:
 
     async def start(self) -> None:
         self.async_engine.start()
+        if self.kv_transfer_port is not None:
+            from llmd_tpu.disagg.transfer import KVTransferClient, KVTransferSource
+
+            self.transfer_source = KVTransferSource(port=self.kv_transfer_port)
+            self.transfer_source.start()
+            self.kv_transfer_port = self.transfer_source.port
+            self.transfer_client = KVTransferClient()
         app = web.Application(client_max_size=32 * 1024 * 1024)
         app.router.add_post("/v1/completions", self._completions)
         app.router.add_post("/v1/chat/completions", self._chat)
@@ -130,6 +154,8 @@ class EngineServer:
 
     async def stop(self) -> None:
         self.async_engine.stop()
+        if self.transfer_source is not None:
+            self.transfer_source.stop()
         if self._runner:
             await self._runner.cleanup()
         if self._pub is not None:
@@ -137,6 +163,28 @@ class EngineServer:
             self._zctx.term()
 
     # -- helpers -----------------------------------------------------------
+    def _pull_remote_kv(self, ktp: "KVTransferParams", token_ids: list[int],
+                        lora_id=None) -> int:
+        """Pull + inject remote prefill KV; any failure → recompute locally
+        (kv_load_failure_policy=recompute, operations-vllm.md:84-100)."""
+        try:
+            pulled = self.transfer_client.pull(
+                ktp.remote_host, ktp.remote_port, ktp.remote_request_id
+            )
+            if pulled is None:
+                self.transfer_stats["pull_failures"] += 1
+                return 0
+            n = self.async_engine.run_locked(
+                lambda: inject_into_engine(self.engine, pulled, token_ids, lora_id)
+            )
+            self.transfer_stats["injected_blocks"] += n
+            # free producer-side blocks (NIXL-notify semantics)
+            self.transfer_client.notify(ktp.remote_host, ktp.remote_port, ktp.remote_request_id)
+            return n
+        except Exception:
+            self.transfer_stats["pull_failures"] += 1
+            return 0
+
     def _tokenize_body(self, body: dict) -> list[int]:
         if body.get("prompt_token_ids"):
             return list(body["prompt_token_ids"])
@@ -167,9 +215,16 @@ class EngineServer:
         stream = bool(body.get("stream", False))
         created = int(time.time())
         model = body.get("model", self.model_name)
+        lora_id = body.get("lora_adapter")
+
+        ktp = KVTransferParams.from_dict(body.get("kv_transfer_params"))
+        if ktp.do_remote_prefill and self.transfer_client is not None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._pull_remote_kv, ktp, token_ids, lora_id
+            )
 
         try:
-            gen = self.async_engine.generate(rid, token_ids, sampling)
+            gen = self.async_engine.generate(rid, token_ids, sampling, lora_id)
             if not stream:
                 out_ids: list[int] = []
                 cached = 0
@@ -189,10 +244,29 @@ class EngineServer:
                     if chat else
                     {"index": 0, "text": text, "finish_reason": reason}
                 )
-                return web.json_response({
+                payload = {
                     "id": rid, "object": "chat.completion" if chat else "text_completion",
                     "created": created, "model": model, "usage": usage, "choices": [choice],
-                })
+                }
+                if ktp.do_remote_decode and self.transfer_source is not None:
+                    # executor thread: the engine lock + D2H gather must not stall
+                    # the event loop (streams/probes keep flowing during export)
+                    out_params = await asyncio.get_running_loop().run_in_executor(
+                        None,
+                        lambda: self.async_engine.run_locked(
+                            lambda: export_from_engine(
+                                self.engine, self.transfer_source, rid, token_ids, lora_id
+                            )
+                        ),
+                    )
+                    # advertise a routable host, never the bind-any address — the
+                    # sidecar falls back to the prefiller's header host when unset
+                    routable = self.advertise_host or self.host
+                    if routable not in ("0.0.0.0", "::", ""):
+                        out_params.remote_host = routable
+                    out_params.remote_port = self.transfer_source.port
+                    payload["kv_transfer_params"] = out_params.to_dict()
+                return web.json_response(payload)
 
             resp = web.StreamResponse(headers={
                 "Content-Type": "text/event-stream", "Cache-Control": "no-cache",
@@ -247,6 +321,16 @@ class EngineServer:
             f"llmd_tpu:preemptions_total {s.total_preemptions}",
             f"llmd_tpu:requests_total {self.request_count}",
         ]
+        if self.transfer_source is not None:
+            ts = self.transfer_source.stats
+            lines += [
+                f"llmd_tpu:kv_transfer_exports_total {ts['exports']}",
+                f"llmd_tpu:kv_transfer_pulls_total {ts['pulls']}",
+                f"llmd_tpu:kv_transfer_notifies_total {ts['notifies']}",
+                f"llmd_tpu:kv_transfer_expired_total {ts['expired']}",
+                f"llmd_tpu:kv_transfer_injected_blocks_total {self.transfer_stats['injected_blocks']}",
+                f"llmd_tpu:kv_transfer_pull_failures_total {self.transfer_stats['pull_failures']}",
+            ]
         if self.engine.offload is not None:
             st = self.engine.offload.store
             lines += [

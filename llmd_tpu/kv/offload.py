@@ -134,8 +134,27 @@ class KVOffloadConnector:
 
     # ------------------------------------------------------------------ evict
     def on_evict(self, cache, block_hash: int, page_id: int) -> None:
-        """Copy an about-to-be-recycled page HBM→host (one device-to-host transfer)."""
+        """Backstop for demand outrunning the proactive drain: copy an
+        about-to-be-recycled page HBM→host (one per-page device sync — the batched
+        ``demote_batch`` path is the steady-state eviction route)."""
         self.store.put(block_hash, np.asarray(cache[:, :, page_id]))
+
+    def demote_batch(self, cache, pairs: list[tuple[int, int]]) -> None:
+        """Offload a batch of demoted pages in ONE device-to-host gather.
+
+        ``pairs`` come from PageAllocator.demote_lru; the pages are already on the
+        free list but their contents are intact until reallocated and rewritten,
+        which cannot happen before this returns (single step thread)."""
+        if not pairs:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        pids = jnp.asarray(np.asarray([pid for _, pid in pairs], np.int32))
+        arr = np.asarray(jax.device_get(cache[:, :, pids]))  # [L, 2, n, ps, Hk, Dh]
+        arr = np.moveaxis(arr, 2, 0)
+        for (h, _), block in zip(pairs, arr):
+            self.store.put(h, np.ascontiguousarray(block))
 
     # ------------------------------------------------------------------ match
     def match_suffix(self, block_hashes: list[int]) -> int:

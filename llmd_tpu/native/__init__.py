@@ -1,0 +1,11 @@
+"""Native (C++) runtime components, built on demand and loaded via ctypes.
+
+The reference ships its data-plane libraries as C++ (NIXL, UCX, NVSHMEM — SURVEY.md
+§2.5); ours are compiled from csrc/ with the toolchain baked into the image (g++).
+No pybind11 in the image → plain C ABI + ctypes. Every native component has a Python
+fallback so the framework degrades gracefully where a compiler is unavailable.
+"""
+
+from llmd_tpu.native.build import load_library, native_available
+
+__all__ = ["load_library", "native_available"]

@@ -1,0 +1,65 @@
+"""On-demand g++ build + ctypes loader for csrc/ libraries, with result caching.
+
+Build artifacts land in ``csrc/.build/<name>-<source_hash>.so`` so rebuilds happen
+only when the source changes; concurrent builders race benignly (atomic rename).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+_CSRC = Path(__file__).resolve().parent.parent.parent / "csrc"
+_CACHE: dict[str, Optional[ctypes.CDLL]] = {}
+
+
+def _build(name: str) -> Optional[Path]:
+    src = _CSRC / f"{name}.cpp"
+    if not src.exists():
+        return None
+    digest = hashlib.sha256(src.read_bytes()).hexdigest()[:16]
+    build_dir = _CSRC / ".build"
+    out = build_dir / f"{name}-{digest}.so"
+    if out.exists():
+        return out
+    build_dir.mkdir(exist_ok=True)
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=build_dir)
+    os.close(fd)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-Wall", "-Werror", str(src), "-o", tmp,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)
+        return out
+    except (subprocess.SubprocessError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and dlopen csrc/<name>.cpp; None if unbuildable."""
+    if name in _CACHE:
+        return _CACHE[name]
+    path = _build(name)
+    lib = None
+    if path is not None:
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError:
+            lib = None
+    _CACHE[name] = lib
+    return lib
+
+
+def native_available(name: str) -> bool:
+    return load_library(name) is not None

@@ -1,0 +1,198 @@
+"""P/D disaggregation tests: transfer roundtrip, sidecar flow, e2e correctness.
+
+Mirrors the reference's disaggregation semantics (disaggregation/README.md): the
+decode output through the P/D path must equal the aggregated path (KV transfer is
+exact, not approximate), prefill-side blocks are freed on notify, and a dead
+prefiller degrades to decoder-only fallback.
+"""
+
+import asyncio
+
+import aiohttp
+import numpy as np
+import jax.numpy as jnp
+
+from llmd_tpu.core.kv_events import block_keys_for_tokens
+from llmd_tpu.core.request import HDR_PREFILLER_HOST_PORT
+from llmd_tpu.disagg.sidecar import RoutingSidecar
+from llmd_tpu.disagg.transfer import (
+    KVTransferClient,
+    KVTransferSource,
+    extract_blocks,
+    insert_blocks,
+)
+from llmd_tpu.engine.config import EngineConfig
+from llmd_tpu.engine.server import EngineServer
+from llmd_tpu.models import get_model_config
+from tests.conftest import run_async
+
+
+def test_extract_insert_roundtrip():
+    cache = jnp.arange(2 * 2 * 6 * 4 * 2 * 3, dtype=jnp.float32).reshape(2, 2, 6, 4, 2, 3)
+    blocks = extract_blocks(cache, [1, 4])
+    assert blocks.shape == (2, 2, 2, 4, 2, 3)
+    target = jnp.zeros_like(cache)
+    out = insert_blocks(target, [0, 5], blocks)
+    np.testing.assert_array_equal(np.asarray(out[:, :, 0]), np.asarray(cache[:, :, 1]))
+    np.testing.assert_array_equal(np.asarray(out[:, :, 5]), np.asarray(cache[:, :, 4]))
+    np.testing.assert_array_equal(np.asarray(out[:, :, 2]), 0)
+
+
+import pytest
+
+
+@pytest.mark.parametrize("transport", ["python", "native"])
+def test_transfer_pull_and_notify(transport):
+    if transport == "native":
+        from llmd_tpu.native import native_available
+
+        if not native_available("kv_transfer"):
+            pytest.skip("g++ build unavailable")
+    src = KVTransferSource(host="127.0.0.1", transport=transport)
+    src.start()
+    try:
+        blocks = np.arange(2 * 3 * 2 * 4 * 2 * 3, dtype=np.float32).reshape(2, 3, 2, 4, 2, 3)
+        src.register("req-1", [11, 22], [[1, 2], [3, 4]], blocks)
+        cli = KVTransferClient(timeout_s=5)
+        pulled = cli.pull("127.0.0.1", src.port, "req-1")
+        assert pulled is not None
+        assert pulled.block_hashes == [11, 22]
+        assert pulled.token_chunks == [[1, 2], [3, 4]]
+        np.testing.assert_array_equal(pulled.blocks, blocks)
+        # unknown id → miss, not error
+        assert cli.pull("127.0.0.1", src.port, "nope") is None
+        # notify frees the export
+        assert cli.notify("127.0.0.1", src.port, "req-1")
+        assert len(src) == 0
+        assert src.stats["pulls"] == 1 and src.stats["notifies"] == 1
+        assert (src.native is not None) == (transport == "native")
+    finally:
+        src.stop()
+
+
+def _engine_cfg():
+    return EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                        max_batch_size=4, prefill_chunk=32)
+
+
+PROMPT = "the quick brown fox jumps over the lazy dog and keeps on running far"
+
+
+async def _pd_scenario():
+    cfg = get_model_config("tiny")
+    # identical seed → identical weights on P, D, and the aggregated control engine
+    prefill = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                           port=0, kv_transfer_port=0)
+    decode = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                          port=0, kv_transfer_port=0)
+    control = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1", port=0)
+    await prefill.start()
+    await decode.start()
+    await control.start()
+    sidecar = RoutingSidecar(decode_addr=decode.address, host="127.0.0.1", port=0)
+    await sidecar.start()
+    try:
+        body = {"prompt": PROMPT, "max_tokens": 8, "temperature": 0.0, "ignore_eos": True}
+        async with aiohttp.ClientSession() as sess:
+            # control: aggregated single-engine output
+            r = await sess.post(f"http://{control.address}/v1/completions", json=body)
+            expected = (await r.json())["choices"][0]["text"]
+
+            # P/D path through the sidecar
+            r = await sess.post(
+                f"http://{sidecar.address}/v1/completions", json=body,
+                headers={HDR_PREFILLER_HOST_PORT: prefill.address},
+            )
+            assert r.status == 200, await r.text()
+            got = await r.json()
+            assert got["choices"][0]["text"] == expected
+            # decode reused transferred KV: complete prompt blocks were cached
+            # (admission reuses at most (prompt_len-1)//ps blocks — the final token's
+            # logits must be computed locally)
+            n_blocks = len(block_keys_for_tokens(list(PROMPT.encode()), 8))
+            n_reusable = min(n_blocks, (len(PROMPT.encode()) - 1) // 8)
+            assert got["usage"]["cached_tokens"] == n_reusable * 8
+            assert decode.transfer_stats["injected_blocks"] == n_blocks
+            # notify freed prefill-side exports
+            assert len(prefill.transfer_source) == 0
+            assert prefill.transfer_source.stats["notifies"] == 1
+            assert sidecar.stats["pd_requests"] == 1
+
+            # streaming through the P/D path works end to end
+            r = await sess.post(
+                f"http://{sidecar.address}/v1/completions",
+                json={**body, "stream": True},
+                headers={HDR_PREFILLER_HOST_PORT: prefill.address},
+            )
+            text = ""
+            async for line in r.content:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    import json as _json
+
+                    text += _json.loads(line[6:])["choices"][0]["text"]
+            assert text == expected
+
+            # dead prefiller → decoder-only fallback still answers correctly
+            r = await sess.post(
+                f"http://{sidecar.address}/v1/completions", json=body,
+                headers={HDR_PREFILLER_HOST_PORT: "127.0.0.1:1"},
+            )
+            assert r.status == 200
+            assert (await r.json())["choices"][0]["text"] == expected
+            assert sidecar.stats["prefill_fallbacks"] == 1
+
+            # no header → plain aggregated proxying
+            r = await sess.post(f"http://{sidecar.address}/v1/completions", json=body)
+            assert r.status == 200
+            assert (await r.json())["choices"][0]["text"] == expected
+
+            # passthrough routes (health/metrics) proxy to the decode engine
+            r = await sess.get(f"http://{sidecar.address}/health")
+            assert r.status == 200
+            r = await sess.get(f"http://{sidecar.address}/metrics")
+            assert "llmd_tpu:kv_transfer_injected_blocks_total" in await r.text()
+    finally:
+        await sidecar.stop()
+        await prefill.stop()
+        await decode.stop()
+        await control.stop()
+
+
+def test_pd_disaggregation_e2e():
+    run_async(_pd_scenario())
+
+
+async def _stale_pull_scenario():
+    """Hash-chain verification: decode must reject an export for a DIFFERENT prompt."""
+    cfg = get_model_config("tiny")
+    prefill = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                           port=0, kv_transfer_port=0)
+    decode = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                          port=0, kv_transfer_port=0)
+    await prefill.start()
+    await decode.start()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            r = await sess.post(f"http://{prefill.address}/v1/completions", json={
+                "prompt": PROMPT, "max_tokens": 1, "temperature": 0.0, "ignore_eos": True,
+                "kv_transfer_params": {"do_remote_decode": True},
+            })
+            ktp = (await r.json())["kv_transfer_params"]
+            # decode a DIFFERENT prompt claiming that transfer handle
+            r = await sess.post(f"http://{decode.address}/v1/completions", json={
+                "prompt": "a completely different prompt that shares no prefix at all!",
+                "max_tokens": 4, "temperature": 0.0, "ignore_eos": True,
+                "kv_transfer_params": {"do_remote_prefill": True, **ktp},
+            })
+            assert r.status == 200
+            got = await r.json()
+            assert got["usage"]["cached_tokens"] == 0  # nothing injected
+            assert decode.transfer_stats["injected_blocks"] == 0
+    finally:
+        await prefill.stop()
+        await decode.stop()
+
+
+def test_stale_transfer_rejected():
+    run_async(_stale_pull_scenario())
