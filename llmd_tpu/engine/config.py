@@ -37,6 +37,10 @@ class EngineConfig:
     offload_fs_path: "str | None" = None
     # P/D role (disaggregation/README.md roles kv_producer/kv_consumer/both)
     role: str = "both"
+    # Attention kernel: "auto" = Pallas on TPU / reference semantics elsewhere,
+    # "pallas" = force the Pallas kernel (interpret mode off-TPU), "reference" =
+    # gather+mask semantics (models.transformer.paged_attention).
+    attn_impl: str = "auto"
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -111,6 +111,7 @@ class LLMEngine:
 
         cfg = model_cfg
         mesh = self.mesh
+        attn = self._select_attn_impl()
 
         def _bind(x, *axes):
             """GSPMD sharding constraint by mesh axis names (no-op off-mesh)."""
@@ -125,7 +126,8 @@ class LLMEngine:
             tokens = _bind(tokens, "sp")
             positions = _bind(positions, "sp")
             logits, cache = forward(
-                cfg, params, cache, tokens[None], positions[None], page_table[None], kv_len[None]
+                cfg, params, cache, tokens[None], positions[None], page_table[None],
+                kv_len[None], attn_impl=attn,
             )
             return logits[0], cache
 
@@ -136,7 +138,8 @@ class LLMEngine:
             page_tables = _bind(page_tables, "dp", None)
             kv_lens = _bind(kv_lens, "dp")
             logits, cache = forward(
-                cfg, params, cache, tokens[:, None], positions[:, None], page_tables, kv_lens
+                cfg, params, cache, tokens[:, None], positions[:, None], page_tables,
+                kv_lens, attn_impl=attn,
             )
             return logits[:, 0], cache
 
@@ -152,7 +155,8 @@ class LLMEngine:
             def body(carry, _):
                 cache, toks, pos, lens, key = carry
                 logits, cache = forward(
-                    cfg, params, cache, toks[:, None], pos[:, None], page_tables, lens
+                    cfg, params, cache, toks[:, None], pos[:, None], page_tables, lens,
+                    attn_impl=attn,
                 )
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens(logits[:, 0].astype(jnp.float32), sub, temp, top_k, top_p)
@@ -171,6 +175,36 @@ class LLMEngine:
         self._prefill_fn = jax.jit(_prefill, **donate)
         self._decode_fn = jax.jit(_decode, **donate)
         self._decode_multi_fn = jax.jit(_decode_multi, **donate)
+
+    def _select_attn_impl(self):
+        """Pick the attention kernel: Pallas on TPU (after a smoke compile),
+        reference gather+mask semantics elsewhere or on kernel failure."""
+        from llmd_tpu.models.transformer import paged_attention
+
+        mode = self.cfg.attn_impl
+        if mode == "reference":
+            return paged_attention
+        want_pallas = mode == "pallas" or (
+            mode == "auto" and jax.default_backend() == "tpu"
+        )
+        if not want_pallas:
+            return paged_attention
+        from llmd_tpu.ops.paged_attention import paged_attention_pallas
+
+        try:  # smoke-compile on tiny shapes so a Mosaic failure can't strand serving
+            c = self.model_cfg
+            q = jnp.zeros((1, 1, c.num_heads, c.head_dim), c.jax_dtype)
+            cache = jnp.zeros((2, 2, self.cfg.page_size, c.num_kv_heads, c.head_dim),
+                              c.jax_dtype)
+            pt = jnp.zeros((1, 1), jnp.int32)
+            paged_attention_pallas(
+                q, cache, pt, jnp.zeros((1, 1), jnp.int32), jnp.ones((1,), jnp.int32)
+            ).block_until_ready()
+            return paged_attention_pallas
+        except Exception:
+            if mode == "pallas":
+                raise
+            return paged_attention
 
     # ------------------------------------------------------------------ API
     def add_request(

@@ -1,0 +1,185 @@
+"""Pallas ragged paged attention: the TPU-native answer to FlashInfer (SURVEY.md §2.5
+N8, docker/Dockerfile.cuda:70-71).
+
+Design (flash-decoding over a paged KV cache):
+- grid ``(batch, kv_head)``; each program owns one sequence × one KV-head group and
+  streams that sequence's pages HBM→VMEM with async DMA, ``pages_per_tile`` pages per
+  iteration (tiles sized to the 128-lane MXU width),
+- page indirection rides on **scalar prefetch**: the page table is available before
+  the body runs, so DMA source addresses are computed in SMEM — no gather
+  materialization of ``[B, S, Hk, Dh]`` in HBM (the reference-semantics fallback in
+  ``models.transformer.paged_attention`` does exactly that gather; this kernel
+  replaces it on TPU),
+- online softmax (running max/sum) in fp32 VMEM scratch — single pass over KV, no
+  ``[B, T, S]`` score materialization,
+- tiles past ``kv_len`` are skipped entirely (``@pl.when``) — ragged batches pay for
+  the KV they have, not the padded maximum,
+- GQA: queries are regrouped to ``[B, Hk, T*q_per_kv, Dh]`` outside so each program's
+  matmuls run over all queries sharing its KV head.
+
+Decode (T=1) is HBM-bandwidth-bound: the win is streaming KV once at full bandwidth.
+Prefill chunks (T=chunk) reuse the same kernel with more query rows per program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, max_pages] int32 page table (SMEM)
+    len_ref,  # [B] int32 kv lengths (SMEM)
+    # inputs
+    q_ref,  # [1, 1, R, Dh] queries for (b, kh), R = T * q_per_kv (VMEM)
+    pos_ref,  # [1, R, 1] int32 query positions, -1 = padding (VMEM, column layout)
+    k_hbm,  # [P, ps, Hk, Dh] key pages (stays in HBM)
+    v_hbm,  # [P, ps, Hk, Dh] value pages (stays in HBM)
+    # outputs
+    o_ref,  # [1, 1, R, Dh] (VMEM)
+    # scratch
+    k_buf,  # [kv_tile, Dh] (VMEM)
+    v_buf,  # [kv_tile, Dh] (VMEM)
+    acc,  # [R, Dh] f32
+    m_s,  # [R, 128] f32 running max (lane-replicated)
+    l_s,  # [R, 128] f32 running sum (lane-replicated)
+    sems,  # DMA sems [2, pages_per_tile]
+    *,
+    pages_per_tile: int,
+    page_size: int,
+    max_pages: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    kh = pl.program_id(1)
+    kv_tile = pages_per_tile * page_size
+    n_tiles = pl.cdiv(max_pages, pages_per_tile)
+    kv_len = len_ref[b]
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # [R, Dh]
+    qpos_col = pos_ref[0]  # [R, 1] — column layout avoids 1D-vector relayouts
+    R = q.shape[0]
+
+    acc[:] = jnp.zeros_like(acc)
+    m_s[:] = jnp.full_like(m_s, NEG_INF)
+    l_s[:] = jnp.zeros_like(l_s)
+
+    def tile_body(t, _):
+        base = t * kv_tile
+
+        @pl.when(base < kv_len)
+        def _():
+            # stage this tile's pages into contiguous VMEM (ragged → dense)
+            for j in range(pages_per_tile):
+                pidx = t * pages_per_tile + j
+                page = jnp.where(pidx < max_pages, pt_ref[b, pidx], 0)
+                page = jnp.maximum(page, 0)  # -1 (unmapped) → masked below
+                pltpu.make_async_copy(
+                    k_hbm.at[page, :, kh], k_buf.at[pl.ds(j * page_size, page_size), :],
+                    sems.at[0, j],
+                ).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[page, :, kh], v_buf.at[pl.ds(j * page_size, page_size), :],
+                    sems.at[1, j],
+                ).start()
+            for j in range(pages_per_tile):
+                pltpu.make_async_copy(
+                    k_hbm.at[0, :, kh], k_buf.at[pl.ds(j * page_size, page_size), :],
+                    sems.at[0, j],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[0, :, kh], v_buf.at[pl.ds(j * page_size, page_size), :],
+                    sems.at[1, j],
+                ).wait()
+
+            k = k_buf[:].astype(jnp.float32)  # [kv_tile, Dh]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )  # [R, kv_tile]
+            key_pos = base + jax.lax.broadcasted_iota(jnp.int32, (R, kv_tile), 1)
+            mask = (key_pos < kv_len) & (key_pos <= qpos_col) & (qpos_col >= 0)
+            s = jnp.where(mask, s, NEG_INF)
+
+            m_prev = m_s[:]  # [R, 128]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)  # [R, 128]
+            p = jnp.exp(s - m_new[:, :1])  # [R, kv_tile]
+            l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            m_s[:] = m_new
+            pv = jax.lax.dot_general(
+                p, v_buf[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [R, Dh]
+            acc[:] = acc[:] * alpha[:, :1] + pv
+
+        return 0
+
+    jax.lax.fori_loop(0, n_tiles, tile_body, 0)
+    l = jnp.maximum(l_s[:, :1], 1e-30)  # padding rows: l=0 → zeros, not NaN
+    o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("kv_tile_target", "interpret"))
+def paged_attention_pallas(
+    q: jax.Array,  # [B, T, H, Dh]
+    layer_cache: jax.Array,  # [2, P, ps, Hk, Dh]
+    page_tables: jax.Array,  # [B, max_pages] int32 (-1 = unmapped)
+    q_positions: jax.Array,  # [B, T] int32 global positions (-1 = padding)
+    kv_lens: jax.Array,  # [B] int32 tokens resident incl. this step's
+    kv_tile_target: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in replacement for models.transformer.paged_attention (same contract)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, T, H, Dh = q.shape
+    _, P, ps, Hk, _ = layer_cache.shape
+    qpk = H // Hk
+    R = T * qpk
+    max_pages = page_tables.shape[1]
+    ppt = max(1, kv_tile_target // ps)
+    kv_tile = ppt * ps
+
+    # group queries by their KV head: [B, Hk, R, Dh], rows ordered (t, q-in-group)
+    qg = q.reshape(B, T, Hk, qpk, Dh).transpose(0, 2, 1, 3, 4).reshape(B, Hk, R, Dh)
+    pos = jnp.repeat(q_positions[:, :, None], qpk, axis=2).reshape(B, R, 1)
+    kc, vc = layer_cache[0], layer_cache[1]
+
+    kernel = functools.partial(
+        _attn_kernel, pages_per_tile=ppt, page_size=ps, max_pages=max_pages,
+        scale=Dh ** -0.5,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hk),
+        in_specs=[
+            pl.BlockSpec((1, 1, R, Dh), lambda b, kh, pt, kl: (b, kh, 0, 0)),
+            pl.BlockSpec((1, R, 1), lambda b, kh, pt, kl: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, R, Dh), lambda b, kh, pt, kl: (b, kh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kv_tile, Dh), layer_cache.dtype),
+            pltpu.VMEM((kv_tile, Dh), layer_cache.dtype),
+            pltpu.VMEM((R, Dh), jnp.float32),
+            pltpu.VMEM((R, 128), jnp.float32),
+            pltpu.VMEM((R, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, ppt)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hk, R, Dh), layer_cache.dtype),
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, pos, kc, vc)
+    return out.reshape(B, Hk, T, qpk, Dh).transpose(0, 2, 1, 3, 4).reshape(B, T, H, Dh)
