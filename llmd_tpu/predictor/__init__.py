@@ -1,0 +1,27 @@
+"""Latency predictor: online-trained TTFT/TPOT models + EPP plugins.
+
+Parity: reference docs/architecture/advanced/latency-predictor.md — training sidecar
+(sliding window, stratified bucketing) + prediction sidecars sharing a model volume
+(:20-57), feature sets (:76-97), EPP plugin suite (:108-140), heuristic fallback on
+outage (:52), actual-vs-predicted observability (:142-160). XGBoost → sklearn
+HistGradientBoosting (same GBDT family; the image carries no xgboost).
+"""
+
+from llmd_tpu.predictor.model import (
+    LatencyModel,
+    LatencySample,
+    StratifiedWindow,
+    ttft_features,
+    tpot_features,
+)
+from llmd_tpu.predictor.client import LocalPredictor, SidecarPredictorClient
+
+__all__ = [
+    "LatencyModel",
+    "LatencySample",
+    "StratifiedWindow",
+    "LocalPredictor",
+    "SidecarPredictorClient",
+    "ttft_features",
+    "tpot_features",
+]

@@ -1,0 +1,205 @@
+"""Latency-predictor sidecars: training server + prediction server.
+
+Deployment shape per reference latency-predictor.md:22-57: both run next to the EPP;
+the training server ingests completed-request samples and periodically refits,
+writing the model to a shared volume; N prediction servers watch that file and answer
+the EPP's hot-path /predict calls (scale-out table :99-107).
+
+API:
+  training server:   POST /samples {"samples": [{...feature/latency fields...}]}
+                     GET  /model/info
+  prediction server: POST /predict {"samples": [{...feature fields...}]}
+                     → {"predictions": [{"ttft_ms": x|null, "tpot_ms": y|null}]}
+  both:              GET /health, GET /metrics
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+from aiohttp import web
+
+from llmd_tpu.predictor.model import LatencyModel, LatencySample, StratifiedWindow
+
+_SAMPLE_FIELDS = (
+    "kv_usage", "input_len", "queue_depth", "running_requests",
+    "prefix_match_pct", "inflight_tokens", "tokens_generated", "ttft_ms", "tpot_ms",
+)
+
+
+def sample_from_dict(d: dict) -> LatencySample:
+    return LatencySample(**{k: d[k] for k in _SAMPLE_FIELDS if d.get(k) is not None})
+
+
+class TrainingServer:
+    """Ingests samples into the stratified window; refits on an interval."""
+
+    def __init__(self, model_path: str, host: str = "127.0.0.1", port: int = 0,
+                 retrain_interval_s: float = 5.0, per_bucket_cap: int = 256) -> None:
+        self.model_path = model_path
+        self.host, self.port = host, port
+        self.retrain_interval = retrain_interval_s
+        self.window = StratifiedWindow(per_bucket_cap)
+        self.model = LatencyModel()
+        self.samples_total = 0
+        self._runner: Optional[web.AppRunner] = None
+        self._task: Optional[asyncio.Task] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_post("/samples", self._samples)
+        app.router.add_get("/model/info", self._info)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self._task = asyncio.get_running_loop().create_task(self._retrain_loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def retrain_now(self) -> bool:
+        """One fit cycle (also used by tests to avoid sleeping out the interval)."""
+        samples = self.window.snapshot()
+        if not samples:
+            return False
+        loop = asyncio.get_running_loop()
+        fitted = await loop.run_in_executor(None, self.model.fit, samples)
+        if fitted:
+            await loop.run_in_executor(None, self.model.save, self.model_path)
+        return fitted
+
+    async def _retrain_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.retrain_interval)
+            try:
+                await self.retrain_now()
+            except Exception:
+                pass  # a bad fit cycle must not kill ingestion
+
+    async def _samples(self, request: web.Request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        rows = body.get("samples", [])
+        for d in rows:
+            self.window.add(sample_from_dict(d))
+        self.samples_total += len(rows)
+        return web.json_response({"accepted": len(rows), "window": len(self.window)})
+
+    async def _info(self, request: web.Request):
+        return web.json_response({
+            "version": self.model.version, "train_count": self.model.train_count,
+            "mape": self.model.mape, "window": len(self.window),
+        })
+
+    async def _health(self, request: web.Request):
+        return web.json_response({"status": "ok"})
+
+    async def _metrics(self, request: web.Request):
+        lines = [
+            f"llmd_tpu:predictor_samples_total {self.samples_total}",
+            f"llmd_tpu:predictor_window_size {len(self.window)}",
+            f"llmd_tpu:predictor_model_version {self.model.version}",
+        ]
+        for k, v in self.model.mape.items():
+            if v is not None:
+                lines.append(f'llmd_tpu:predictor_mape{{target="{k}"}} {v:.6f}')
+        return web.Response(text="\n".join(lines) + "\n")
+
+
+class PredictionServer:
+    """Serves /predict from the newest model on the shared volume (mtime watch)."""
+
+    def __init__(self, model_path: str, host: str = "127.0.0.1", port: int = 0,
+                 reload_interval_s: float = 2.0) -> None:
+        self.model_path = model_path
+        self.host, self.port = host, port
+        self.reload_interval = reload_interval_s
+        self.model: Optional[LatencyModel] = None
+        self._mtime = 0.0
+        self._last_check = 0.0
+        self.predictions_total = 0
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _maybe_reload(self) -> None:
+        now = time.monotonic()
+        if now - self._last_check < self.reload_interval and self.model is not None:
+            return
+        self._last_check = now
+        try:
+            mtime = os.path.getmtime(self.model_path)
+        except OSError:
+            return
+        if mtime > self._mtime:
+            try:
+                self.model = LatencyModel.load(self.model_path)
+                self._mtime = mtime
+            except Exception:
+                pass  # half-written file (save is atomic, but be defensive)
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_post("/predict", self._predict)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _predict(self, request: web.Request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        self._maybe_reload()
+        samples = [sample_from_dict(d) for d in body.get("samples", [])]
+        self.predictions_total += len(samples)
+        if self.model is None or not self.model.is_fit():
+            return web.json_response({"predictions": None, "reason": "model not ready"},
+                                     status=503)
+        preds = self.model.predict(samples)
+        return web.json_response({"predictions": [
+            {"ttft_ms": t, "tpot_ms": p} for t, p in preds
+        ]})
+
+    async def _health(self, request: web.Request):
+        ok = self.model is not None
+        return web.json_response({"status": "ok" if ok else "no model"},
+                                 status=200 if ok else 503)
+
+    async def _metrics(self, request: web.Request):
+        v = self.model.version if self.model else 0
+        return web.Response(text=(
+            f"llmd_tpu:predictor_predictions_total {self.predictions_total}\n"
+            f"llmd_tpu:predictor_loaded_model_version {v}\n"
+        ))

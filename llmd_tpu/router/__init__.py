@@ -19,3 +19,7 @@ from llmd_tpu.router.plugins import (  # noqa: F401
     build_plugin,
 )
 from llmd_tpu.router.scheduler import Scheduler, SchedulingResult  # noqa: F401
+
+# register plugin suites (import side effect populates PLUGIN_REGISTRY)
+from llmd_tpu.router import filters_pickers, latency_plugins, scorers  # noqa: E402,F401
+from llmd_tpu.kv import plugins as _kv_plugins  # noqa: E402,F401

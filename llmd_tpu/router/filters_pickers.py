@@ -38,12 +38,16 @@ class DecodeEndpointsFilter:
 
 @register_plugin("prefix-cache-affinity-filter")
 class PrefixCacheAffinityFilter:
-    """Epsilon-greedy prefix affinity with a load gate (latency-predictor.md:110-115):
-    keep the best-prefix endpoints unless overloaded; epsilon of traffic explores."""
+    """Epsilon-greedy prefix affinity with load gates (latency-predictor.md:110-115):
+    exploit cache-warm endpoints, explore with probability epsilon, and break
+    affinity when the warm pods are materially slower — by queue depth always, and
+    by predicted TTFT when the latency producer has run (the TTFT load gate)."""
 
-    def __init__(self, epsilon: float = 0.05, queue_gate: float = 16.0) -> None:
+    def __init__(self, epsilon: float = 0.05, queue_gate: float = 16.0,
+                 ttft_penalty_ms: float = 500.0) -> None:
         self.epsilon = epsilon
         self.queue_gate = queue_gate
+        self.ttft_penalty_ms = ttft_penalty_ms
 
     def filter(self, req: InferenceRequest, endpoints: list[Endpoint]) -> list[Endpoint]:
         hits = req.state.get(STATE_PREFIX_HITS) or {}
@@ -57,7 +61,21 @@ class PrefixCacheAffinityFilter:
             if hits.get(e.address, 0) == best
             and e.metric(StdMetric.QUEUED_REQUESTS) < self.queue_gate
         ]
-        return keep or endpoints
+        if not keep:
+            return endpoints
+        preds = req.state.get("predicted_latency") or {}
+        if preds:  # TTFT load gate: saturated warm pod must not hoard its prefix
+            warm_best = min(
+                (preds[e.address][0] for e in keep if e.address in preds), default=None
+            )
+            overall_best = min(
+                (preds[e.address][0] for e in endpoints if e.address in preds),
+                default=None,
+            )
+            if warm_best is not None and overall_best is not None \
+                    and warm_best - overall_best > self.ttft_penalty_ms:
+                return endpoints
+        return keep
 
 
 @register_plugin("max-score-picker")

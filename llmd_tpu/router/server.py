@@ -99,6 +99,14 @@ class RouterServer:
             )
         self.objectives = objectives or {}
         self.model_rewrites = model_rewrites or {}
+        # Scheduling runs off the event loop on ONE worker thread: plugins may block
+        # (sidecar predictor RPC) and share per-request mutable state — a single
+        # thread keeps them serialized while the proxy loop stays responsive.
+        import concurrent.futures
+
+        self._sched_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="epp-sched"
+        )
         self._runner: Optional[web.AppRunner] = None
         self._session: Optional[aiohttp.ClientSession] = None
         self.metrics = {
@@ -139,6 +147,7 @@ class RouterServer:
             await self._runner.cleanup()
         if self._session:
             await self._session.close()
+        self._sched_executor.shutdown(wait=False)
 
     # ------------------------------------------------------------------
     def _rewrite_model(self, req: InferenceRequest, body: dict) -> None:
@@ -180,7 +189,9 @@ class RouterServer:
 
         for p in self._async_producers:
             await p.aproduce(req, self.pool.list(), self._session)
-        result = self.scheduler.schedule(req)
+        result = await asyncio.get_running_loop().run_in_executor(
+            self._sched_executor, self.scheduler.schedule, req
+        )
         if result.endpoint is None:
             self.metrics["errors_total"] += 1
             return web.json_response(
@@ -218,23 +229,37 @@ class RouterServer:
                     headers={"Content-Type": "text/event-stream", **echo},
                 )
                 await out.prepare(request)
-                first = True
+                t_first = None
+                t_last = t_start
+                n_chunks = 0
                 async for chunk in resp.content.iter_any():
-                    if first:
-                        self.metrics["ttft_sum"] += time.monotonic() - t_start
+                    t_last = time.monotonic()
+                    if t_first is None:
+                        t_first = t_last
+                        self.metrics["ttft_sum"] += t_first - t_start
                         self.metrics["ttft_count"] += 1
-                        first = False
+                    n_chunks += 1
                     await out.write(chunk)
                 await out.write_eof()
-                self.scheduler.post_response(req, target, {"status": resp.status})
+                info: dict[str, Any] = {"status": resp.status}
+                if t_first is not None:
+                    info["ttft_ms"] = (t_first - t_start) * 1e3
+                    info["e2e_ms"] = (t_last - t_start) * 1e3
+                    if n_chunks > 1:  # mean inter-chunk latency ≈ ITL/TPOT sample
+                        info["itl_ms"] = (t_last - t_first) * 1e3 / (n_chunks - 1)
+                self.scheduler.post_response(req, target, info)
                 self.metrics["responses_total"] += 1
                 return out
             payload = await resp.read()
-            self.metrics["ttft_sum"] += time.monotonic() - t_start
+            e2e_s = time.monotonic() - t_start
+            self.metrics["ttft_sum"] += e2e_s
             self.metrics["ttft_count"] += 1
-            info: dict[str, Any] = {"status": resp.status}
+            info = {"status": resp.status, "e2e_ms": e2e_s * 1e3}
             try:
-                info["usage"] = json.loads(payload).get("usage", {})
+                usage = json.loads(payload).get("usage", {})
+                info["usage"] = usage
+                if usage.get("completion_tokens"):
+                    info["itl_ms"] = e2e_s * 1e3 / usage["completion_tokens"]
             except Exception:
                 pass
             self.scheduler.post_response(req, target, info)
@@ -269,6 +294,9 @@ class RouterServer:
             ]
         if m["ttft_count"]:
             lines.append(f"llm_d_epp_ttft_seconds_mean {m['ttft_sum'] / m['ttft_count']:.6f}")
+        for plugin in self.scheduler.plugins.values():
+            if hasattr(plugin, "prometheus_lines"):
+                lines += plugin.prometheus_lines()
         return web.Response(text="\n".join(lines) + "\n")
 
     async def _health(self, request: web.Request):
