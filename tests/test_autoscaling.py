@@ -1,0 +1,192 @@
+"""Autoscaling plane: saturation/token/SLO analyzers, optimizer, enforcer, engine,
+HPA arithmetic. Mirrors reference wva.md behaviors and hpa-keda.md's dual-metric max."""
+
+import numpy as np
+
+from llmd_tpu.autoscaling import (
+    CostAwareOptimizer,
+    Enforcer,
+    GreedyByScoreOptimizer,
+    HPAEvaluator,
+    KalmanTuner,
+    PoolMetrics,
+    ReplicaMetrics,
+    SLOAnalyzer,
+    SaturationAnalyzer,
+    TokenSaturationAnalyzer,
+    Variant,
+    WVAEngine,
+)
+from llmd_tpu.autoscaling.wva import ScalingSignal
+
+
+def _variants():
+    return [
+        Variant(name="cheap", model_id="m", cost=5.0, min_replicas=1, max_replicas=10,
+                current_replicas=1, desired_replicas=1),
+        Variant(name="fancy", model_id="m", cost=15.0, min_replicas=0, max_replicas=5,
+                current_replicas=1, desired_replicas=1),
+    ]
+
+
+def test_saturation_analyzer_up_down_steady():
+    a = SaturationAnalyzer()
+    vs = _variants()
+    # saturated: kv above threshold → scale up 1
+    pool = PoolMetrics(replicas={"cheap": [ReplicaMetrics(kv_usage=0.95, queue_len=0)]})
+    assert a.analyze(pool, vs).scale_up == 1
+    # queue saturation also triggers
+    pool = PoolMetrics(replicas={"cheap": [ReplicaMetrics(kv_usage=0.1, queue_len=9)]})
+    assert a.analyze(pool, vs).scale_up == 1
+    # idle with many replicas → scale down (N/(N-1) sim keeps headroom)
+    pool = PoolMetrics(replicas={"cheap": [ReplicaMetrics(kv_usage=0.05)] * 4})
+    assert a.analyze(pool, vs).scale_down == 1
+    # moderately loaded → steady
+    pool = PoolMetrics(replicas={"cheap": [ReplicaMetrics(kv_usage=0.55, queue_len=1)] * 2})
+    sig = a.analyze(pool, vs)
+    assert sig.scale_up == 0 and sig.scale_down == 0
+    # transitioning variant blocks all scaling
+    vs[0].desired_replicas = 3
+    pool = PoolMetrics(replicas={"cheap": [ReplicaMetrics(kv_usage=0.99)]})
+    sig = a.analyze(pool, vs)
+    assert sig.scale_up == 0 and "transitioning" in sig.reason
+
+
+def test_token_analyzer_k1_k2_chain():
+    a = TokenSaturationAnalyzer(max_batched_tokens=2048)
+    # memory-bound k1 = blocks*size*0.8 = 1024*16*0.8 = 13107
+    r = ReplicaMetrics(num_blocks=1024, block_size=16, queue_len=0,
+                       avg_in_tokens=256, avg_out_tokens=64)
+    cap_derived = a.replica_capacity(r)
+    assert cap_derived <= 1024 * 16 * 0.8
+    # saturated queue → observed tokens_in_use becomes k2 and enters history
+    r2 = ReplicaMetrics(num_blocks=1024, block_size=16, queue_len=8,
+                        tokens_in_use=5000, avg_out_tokens=64)
+    assert a.replica_capacity(r2) == 5000
+    # historical now serves non-saturated replicas in the same bucket
+    r3 = ReplicaMetrics(num_blocks=1024, block_size=16, queue_len=0, avg_out_tokens=64)
+    assert a.replica_capacity(r3) == 5000
+
+    # demand >> supply → scale up
+    pool = PoolMetrics(
+        replicas={"cheap": [ReplicaMetrics(num_blocks=64, block_size=16,
+                                           tokens_in_use=900, queue_len=6,
+                                           avg_in_tokens=200, avg_out_tokens=64)]},
+        epp_queue_size=10,
+    )
+    sig = TokenSaturationAnalyzer().analyze(pool, _variants())
+    assert sig.scale_up >= 1
+    # nearly idle big pool → scale down
+    pool = PoolMetrics(replicas={"cheap": [
+        ReplicaMetrics(num_blocks=1024, block_size=16, tokens_in_use=100, avg_out_tokens=64)
+    ] * 3})
+    sig = TokenSaturationAnalyzer().analyze(pool, _variants())
+    assert sig.scale_down == 1
+
+
+def test_kalman_tuner_learns_parameters():
+    alpha, beta, gamma = 0.02, 2e-4, 1e-5
+    tuner = KalmanTuner()
+    rng = np.random.default_rng(0)
+    for _ in range(400):
+        inp = float(rng.integers(64, 1024))
+        out = float(rng.integers(16, 256))
+        m = ReplicaMetrics(
+            avg_in_tokens=inp, avg_out_tokens=out,
+            avg_ttft_s=alpha + beta * inp + float(rng.normal(0, 1e-4)),
+            avg_itl_s=alpha + beta + gamma * (inp + out / 2) + float(rng.normal(0, 1e-5)),
+        )
+        tuner.update(m)
+    assert abs(tuner.alpha - alpha) / alpha < 0.3
+    assert abs(tuner.beta - beta) / beta < 0.3
+    assert abs(tuner.gamma - gamma) / gamma < 0.5
+
+
+def test_slo_analyzer_scales_with_rate():
+    a = SLOAnalyzer(target_ttft_s=0.5, target_itl_s=0.05)
+    # feed steady metrics so the tuner has a model
+    mk = lambda rate: ReplicaMetrics(avg_in_tokens=256, avg_out_tokens=64,
+                                     avg_ttft_s=0.08, avg_itl_s=0.01,
+                                     arrival_rate=rate)
+    pool_lo = PoolMetrics(replicas={"cheap": [mk(0.05)]})
+    pool_hi = PoolMetrics(replicas={"cheap": [mk(50.0)]})
+    vs = _variants()
+    for _ in range(10):
+        a.analyze(pool_lo, vs)  # warm the tuner
+    sig_hi = a.analyze(pool_hi, vs)
+    assert sig_hi.scale_up >= 1
+    sig_lo = a.analyze(pool_lo, vs)
+    assert sig_lo.scale_up == 0
+
+
+def test_cost_aware_optimizer_and_enforcer():
+    vs = _variants()
+    CostAwareOptimizer().decide(ScalingSignal(scale_up=2), vs)
+    assert vs[0].desired_replicas == 3  # cheapest took both
+    CostAwareOptimizer().decide(ScalingSignal(scale_down=1), vs)
+    assert vs[1].desired_replicas == 0  # most expensive dropped first
+
+    # scale-to-zero on idle pool (all minReplicas must be 0)
+    vs = [Variant(name="v", model_id="m", cost=1, min_replicas=0, max_replicas=4,
+                  desired_replicas=2, current_replicas=2)]
+    Enforcer(scale_to_zero=True).enforce(PoolMetrics(replicas={}, requests_in_retention=0), vs)
+    assert vs[0].desired_replicas == 0
+    # with traffic in the retention window it stays up
+    vs[0].desired_replicas = 2
+    Enforcer(scale_to_zero=True).enforce(PoolMetrics(replicas={}, requests_in_retention=5), vs)
+    assert vs[0].desired_replicas == 2
+    # scale-to-zero disabled → floor of 1 on the cheapest
+    vs[0].desired_replicas = 0
+    Enforcer(scale_to_zero=False).enforce(PoolMetrics(replicas={}), vs)
+    assert vs[0].desired_replicas == 1
+
+
+def test_greedy_by_score_respects_budget():
+    pools = {
+        "hot": [Variant(name="h", model_id="hot", cost=5, max_replicas=10,
+                        current_replicas=1, desired_replicas=1)],
+        "cold": [Variant(name="c", model_id="cold", cost=5, max_replicas=10,
+                         current_replicas=1, desired_replicas=1)],
+    }
+    signals = {
+        "hot": ScalingSignal(scale_up=3, priority=10.0),
+        "cold": ScalingSignal(scale_up=3, priority=1.0),
+    }
+    GreedyByScoreOptimizer(total_accelerators=4).decide_all(signals, pools)
+    # budget = 4 - 2 existing = 2, all granted to the higher-priority pool
+    assert pools["hot"][0].desired_replicas == 3
+    assert pools["cold"][0].desired_replicas == 1
+
+
+def test_engine_scale_from_zero_and_reconcile():
+    scaled = []
+    v = Variant(name="v", model_id="m", cost=1, min_replicas=0, max_replicas=4,
+                current_replicas=0, desired_replicas=0,
+                scale=lambda n: scaled.append(n))
+    state = {"queue": 0.0}
+    eng = WVAEngine(
+        pools={"m": [v]},
+        metrics_fn=lambda mid: PoolMetrics(replicas={}, epp_queue_size=state["queue"]),
+    )
+    eng.scale_from_zero_step()
+    assert scaled == []  # idle: stays at zero
+    state["queue"] = 3.0
+    eng.scale_from_zero_step()
+    assert scaled == [1]  # queued request woke the pool (100ms path)
+    assert eng.decisions[-1] == ("m", "v", 1)
+
+
+def test_hpa_dual_metric_max():
+    hpa = HPAEvaluator(min_replicas=1, max_replicas=20)
+    # queue 32 vs target 8 at 2 replicas → Value path wants ceil(2*32/8)=8
+    n = hpa.desired_replicas(2, {"igw_queue_depth": 32.0, "igw_running_requests": 10.0})
+    assert n == 8
+    # running 100 vs avg target 16 → AverageValue wants ceil(100/16)=7; queue low
+    n = hpa.desired_replicas(4, {"igw_queue_depth": 1.0, "igw_running_requests": 100.0})
+    assert n == 7
+    # inside tolerance → unchanged
+    n = hpa.desired_replicas(4, {"igw_queue_depth": 0.0, "igw_running_requests": 66.0})
+    assert n == 4
+    # bounds clamp
+    n = hpa.desired_replicas(2, {"igw_queue_depth": 1000.0})
+    assert n == 20
