@@ -3,7 +3,9 @@
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from typing import Optional
 
+from llmd_tpu.parallel.eplb import EPLBConfig
 from llmd_tpu.parallel.mesh import MeshConfig
 
 
@@ -41,6 +43,12 @@ class EngineConfig:
     # "pallas" = force the Pallas kernel (interpret mode off-TPU), "reference" =
     # gather+mask semantics (models.transformer.paged_attention).
     attn_impl: str = "auto"
+    # MoE expert GEMMs: "auto" = Pallas grouped GEMM on TPU / einsum elsewhere,
+    # "pallas" = force (interpret off-TPU), "einsum" = XLA dot path.
+    moe_matmul: str = "auto"
+    # Expert-parallel load balancing with redundant experts (wide-ep --enable-eplb
+    # {window_size, step_interval, num_redundant_experts}); None = disabled.
+    eplb: Optional[EPLBConfig] = None
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -54,6 +54,7 @@ class EngineStats:
     total_decode_tokens: int = 0
     total_preemptions: int = 0
     total_offload_loads: int = 0  # blocks pulled back from CPU/FS tiers
+    eplb_rebalances: int = 0  # wide-EP expert-placement recomputes
 
 
 class LLMEngine:
@@ -109,9 +110,15 @@ class LLMEngine:
                 self.cache, NamedSharding(self.mesh, P(None, None, None, None, "tp", None))
             )
 
+        self._eplb = None
+        if engine_cfg.eplb is not None and model_cfg.is_moe:
+            self._init_eplb()
+
         cfg = model_cfg
         mesh = self.mesh
         attn = self._select_attn_impl()
+        moe_impl = self._select_moe_impl()
+        track = self._eplb is not None
 
         def _bind(x, *axes):
             """GSPMD sharding constraint by mesh axis names (no-op off-mesh)."""
@@ -125,10 +132,15 @@ class LLMEngine:
             # sequence-parallel long-context prefill: chunk dim sharded over sp
             tokens = _bind(tokens, "sp")
             positions = _bind(positions, "sp")
-            logits, cache = forward(
+            out = forward(
                 cfg, params, cache, tokens[None], positions[None], page_table[None],
-                kv_len[None], attn_impl=attn,
+                kv_len[None], attn_impl=attn, moe_matmul_impl=moe_impl,
+                with_expert_counts=track,
             )
+            if track:
+                logits, cache, cnt = out
+                return logits[0], cache, cnt
+            logits, cache = out
             return logits[0], cache
 
         def _decode(params, cache, tokens, positions, page_tables, kv_lens):
@@ -137,10 +149,15 @@ class LLMEngine:
             positions = _bind(positions, "dp")
             page_tables = _bind(page_tables, "dp", None)
             kv_lens = _bind(kv_lens, "dp")
-            logits, cache = forward(
+            out = forward(
                 cfg, params, cache, tokens[:, None], positions[:, None], page_tables,
-                kv_lens, attn_impl=attn,
+                kv_lens, attn_impl=attn, moe_matmul_impl=moe_impl,
+                with_expert_counts=track,
             )
+            if track:
+                logits, cache, cnt = out
+                return logits[:, 0], cache, cnt
+            logits, cache = out
             return logits[:, 0], cache
 
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
@@ -154,21 +171,27 @@ class LLMEngine:
 
             def body(carry, _):
                 cache, toks, pos, lens, key = carry
-                logits, cache = forward(
+                out = forward(
                     cfg, params, cache, toks[:, None], pos[:, None], page_tables, lens,
-                    attn_impl=attn,
+                    attn_impl=attn, moe_matmul_impl=moe_impl, with_expert_counts=track,
                 )
+                if track:
+                    logits, cache, cnt = out
+                else:
+                    (logits, cache), cnt = out, jnp.zeros((0,), jnp.int32)
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens(logits[:, 0].astype(jnp.float32), sub, temp, top_k, top_p)
                 nxt = jnp.where(active_mask, nxt, 0)
                 pos = jnp.where(active_mask, pos + 1, pos)
                 lens = jnp.where(active_mask, lens + 1, lens)
-                return (cache, nxt, pos, lens, key), nxt
+                return (cache, nxt, pos, lens, key), (nxt, cnt)
 
-            (cache, _, _, _, _), toks_out = jax.lax.scan(
+            (cache, _, _, _, _), (toks_out, cnts) = jax.lax.scan(
                 body, (cache, tokens, positions, kv_lens, key), None,
                 length=engine_cfg.decode_steps,
             )
+            if track:
+                return toks_out, cache, cnts.sum(0)  # [k, B], cache, [L, E]
             return toks_out, cache  # [k, B]
 
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
@@ -205,6 +228,108 @@ class LLMEngine:
             if mode == "pallas":
                 raise
             return paged_attention
+
+    def _select_moe_impl(self):
+        """Pick the MoE expert-GEMM path: Pallas grouped GEMM on TPU (after a smoke
+        compile), XLA einsum elsewhere or on kernel failure."""
+        if not self.model_cfg.is_moe:
+            return None
+        mode = self.cfg.moe_matmul
+        if mode == "einsum":
+            return None
+        want = mode == "pallas" or (mode == "auto" and jax.default_backend() == "tpu")
+        if not want:
+            return None
+        from llmd_tpu.ops.grouped_gemm import grouped_gemm, make_moe_matmul
+
+        try:
+            grouped_gemm(
+                jnp.zeros((2, 8, 16), self.model_cfg.jax_dtype),
+                jnp.zeros((2, 16, 128), self.model_cfg.jax_dtype),
+                jnp.array([1, 0], jnp.int32),
+            ).block_until_ready()
+            return make_moe_matmul()
+        except Exception:
+            if mode == "pallas":
+                raise
+            return None
+
+    # ----------------------------------------------------------------- EPLB
+    # Wide-EP expert load balancing (reference --enable-eplb, wide-ep
+    # decode.yaml:114-118). Physical slot weights + replica tables live beside the
+    # logical params and are re-gathered every step_interval engine steps; all
+    # shapes are fixed (R padded to its max) so the step programs never recompile.
+    def _init_eplb(self) -> None:
+        from llmd_tpu.parallel.eplb import ExpertLoadTracker
+
+        e = self.cfg.eplb
+        E, L = self.model_cfg.moe_num_experts, self.model_cfg.num_layers
+        ep = max(1, self.cfg.mesh.ep)
+        S = E + e.num_redundant_experts
+        S += (-S) % ep  # slot dim shards evenly over the ep axis
+        self._eplb = e
+        self._eplb_slots = S
+        self._eplb_rmax = S - E + 1  # one expert could own every redundant slot
+        self._eplb_tracker = ExpertLoadTracker(L, E, e.window_size)
+        self._eplb_steps = 0
+        self._eplb_active = False  # set when a forward actually routed tokens
+
+        mesh = self.mesh
+
+        def _gather(wi, wo, s2e):
+            l = jnp.arange(wi.shape[0])[:, None]
+            wi_p, wo_p = wi[l, s2e], wo[l, s2e]
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                wi_p = jax.lax.with_sharding_constraint(
+                    wi_p, NamedSharding(mesh, P(None, "ep", None, "tp")))
+                wo_p = jax.lax.with_sharding_constraint(
+                    wo_p, NamedSharding(mesh, P(None, "ep", "tp", None)))
+            return wi_p, wo_p
+
+        self._eplb_gather = jax.jit(_gather)
+        self._eplb_rebalance()
+
+    def _eplb_rebalance(self) -> None:
+        from llmd_tpu.parallel.eplb import rebalance
+
+        ep = max(1, self.cfg.mesh.ep)
+        s2e, slots, counts = rebalance(self._eplb_tracker.loads(), self._eplb_slots, ep)
+        L, E, R = slots.shape
+        if R < self._eplb_rmax:  # pad replica dim to its fixed max (no recompiles)
+            pad = np.repeat(slots[:, :, :1], self._eplb_rmax - R, axis=2)
+            slots = np.concatenate([slots, pad], axis=2)
+        wi_p, wo_p = self._eplb_gather(
+            self.params["moe_wi"], self.params["moe_wo"], jnp.asarray(s2e))
+        self._eplb_params = {
+            "moe_wi": wi_p, "moe_wo": wo_p,
+            "eplb_replica_slots": jnp.asarray(slots),
+            "eplb_replica_counts": jnp.asarray(counts),
+        }
+        self._eplb_s2e = s2e
+        self.stats.eplb_rebalances += 1
+
+    def _run_params(self) -> dict[str, jax.Array]:
+        """Params seen by the step programs: physical expert weights under EPLB."""
+        if self._eplb is None:
+            return self.params
+        return {**self.params, **self._eplb_params}
+
+    def _eplb_record(self, cnt: jax.Array) -> None:
+        self._eplb_tracker.record(np.asarray(cnt))
+        self._eplb_active = True
+
+    def _eplb_tick(self) -> None:
+        # Count only steps that routed tokens — idle wave steps (DP lockstep with
+        # no local work) must not burn rebalances, each of which re-gathers the
+        # full expert weights on device.
+        if not self._eplb_active:
+            return
+        self._eplb_active = False
+        self._eplb_steps += 1
+        if self._eplb_steps % self._eplb.step_interval == 0:
+            self._eplb_rebalance()
 
     # ------------------------------------------------------------------ API
     def add_request(
@@ -377,6 +502,8 @@ class LLMEngine:
         self.stats.num_waiting = len(self.waiting)
         self.stats.num_running = sum(1 for s in self.running if s is not None)
         self.stats.kv_utilization = self.alloc.utilization()
+        if self._eplb is not None:
+            self._eplb_tick()
         return self._outputs
 
     def _offload_drain(self) -> None:
@@ -428,10 +555,15 @@ class LLMEngine:
         pt = np.full((self.cfg.max_pages_per_seq,), -1, np.int32)
         pt[: len(seq.pages)] = seq.pages
 
-        logits, self.cache = self._prefill_fn(
-            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+        out = self._prefill_fn(
+            self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(pt), jnp.asarray(start + n, jnp.int32),
         )
+        if self._eplb is not None:
+            logits, self.cache, cnt = out
+            self._eplb_record(cnt)
+        else:
+            logits, self.cache = out
         seq.num_computed = start + n
         seq.maybe_commit_blocks(self.alloc)
         self.stats.total_prefill_tokens += n
@@ -486,10 +618,15 @@ class LLMEngine:
             lens[i] = len(s.token_ids)
 
         if k == 1:
-            logits, self.cache = self._decode_fn(
-                self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+            out = self._decode_fn(
+                self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
                 jnp.asarray(pts), jnp.asarray(lens),
             )
+            if self._eplb is not None:
+                logits, self.cache, cnt = out
+                self._eplb_record(cnt)
+            else:
+                logits, self.cache = out
             for s in active:
                 s.num_computed = len(s.token_ids)
                 s.maybe_commit_blocks(self.alloc)
@@ -509,11 +646,16 @@ class LLMEngine:
             temp[s.slot], tk[s.slot], tp[s.slot] = sp.temperature, sp.top_k, sp.top_p
             mask[s.slot] = True
         self._key, sub = jax.random.split(self._key)
-        toks_out, self.cache = self._decode_multi_fn(
-            self.params, self.cache, jnp.asarray(toks), jnp.asarray(pos),
+        out = self._decode_multi_fn(
+            self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(tk),
             jnp.asarray(tp), sub, jnp.asarray(mask),
         )
+        if self._eplb is not None:
+            toks_out, self.cache, cnt = out
+            self._eplb_record(cnt)
+        else:
+            toks_out, self.cache = out
         toks_out = np.asarray(toks_out)  # [k, B]
         now = time.monotonic()
         for s in active:

@@ -28,6 +28,9 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     moe_num_shared_experts: int = 0
     moe_capacity_factor: float = 1.25
+    # Dual-batch overlap: split MoE tokens into two independent half-batches so XLA
+    # overlaps one half's all-to-all with the other's expert GEMMs (--enable-dbo).
+    moe_dbo: bool = False
 
     @property
     def jax_dtype(self):

@@ -128,39 +128,91 @@ def swiglu(x: jax.Array, wi: jax.Array, wo: jax.Array) -> jax.Array:
     return jnp.einsum("...f,fd->...d", jax.nn.silu(gate) * up, wo)
 
 
-def moe_block(cfg: ModelConfig, x: jax.Array, router, wi, wo) -> jax.Array:
+def moe_block(
+    cfg: ModelConfig,
+    x: jax.Array,
+    router,
+    wi,
+    wo,
+    eplb: Optional[tuple[jax.Array, jax.Array]] = None,
+    matmul_impl=None,
+    token_mask: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
     """Top-k routed MoE with capacity-based dispatch (XLA-friendly static shapes).
 
     x: [T, D]. Expert dim is sharded over the `ep` mesh axis; the dispatch/combine
     einsums lower to all-to-all when tokens are dp/sp-sharded — the XLA-native stand-in
-    for DeepEP's NVSHMEM all-to-all (reference wide-ep decode.yaml:87-121). A Pallas
-    ragged all-to-all variant can replace it without touching callers.
+    for DeepEP's NVSHMEM all-to-all (reference wide-ep decode.yaml:87-121).
+
+    ``eplb = (replica_slots [E, R], replica_counts [E])`` switches to redundant-expert
+    dispatch: ``wi``/``wo`` then hold *physical slot* weights [S, ...] (S >= E, slot
+    order = EP-rank placement, see parallel.eplb) and each token spreads across its
+    expert's replicas round-robin. ``matmul_impl(xe, w, slot_counts)`` overrides the
+    expert GEMMs (Pallas grouped GEMM on TPU — reference DeepGEMM's role, SURVEY §2.5
+    N7). Returns (y [T, D], logical expert counts [E] int32).
+
+    ``cfg.moe_dbo`` splits tokens into two independent half-batches so XLA can overlap
+    one half's all-to-all with the other's GEMMs (reference --enable-dbo,
+    wide-ep decode.yaml:87-121).
     """
     T, D = x.shape
     E, k = cfg.moe_num_experts, cfg.moe_top_k
-    C = max(1, int(T * k / E * cfg.moe_capacity_factor))
 
     logits = jnp.einsum("td,de->te", x.astype(jnp.float32), router.astype(jnp.float32))
     weights = jax.nn.softmax(logits, axis=-1)
     topw, topi = lax.top_k(weights, k)  # [T, k]
     topw = topw / (jnp.sum(topw, axis=-1, keepdims=True) + 1e-9)
+    # Padding tokens (prefill chunk tail, idle decode slots) must not consume
+    # expert capacity nor pollute the EPLB load stats.
+    valid = (
+        token_mask.astype(jnp.int32)[:, None]
+        if token_mask is not None
+        else jnp.ones((T, 1), jnp.int32)
+    )  # [T, 1]
+    counts = jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.int32) * valid[..., None], axis=(0, 1))
 
-    # position of each (token, slot) within its expert's capacity buffer
-    onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)  # [T, k, E]
-    flat = onehot.reshape(T * k, E)
-    pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(T, k, E)
-    keep = (pos_in_expert < C).astype(x.dtype) * onehot.astype(x.dtype)
-    # dispatch tensor [T, k, E, C]
-    disp = keep[..., None] * jax.nn.one_hot(pos_in_expert, C, dtype=x.dtype)
-    comb = disp * topw[..., None, None].astype(x.dtype)
-    disp2 = disp.sum(1)  # [T, E, C]
-    comb2 = comb.sum(1)
+    if eplb is not None:
+        replica_slots, replica_counts = eplb  # [E, R], [E]
+        S = wi.shape[0]
+        rc = replica_counts[topi]  # [T, k]
+        choice = (jnp.arange(T, dtype=jnp.int32)[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]) % rc
+        idx = replica_slots[topi, choice]  # [T, k] physical slot ids
+    else:
+        S, idx = E, topi
 
-    xe = jnp.einsum("tec,td->ecd", disp2, x)  # all-to-all in, [E, C, D]
-    gate_up = jnp.einsum("ecd,edf->ecf", xe, wi)
-    gate, up = jnp.split(gate_up, 2, axis=-1)
-    ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, wo)
-    return jnp.einsum("tec,ecd->td", comb2, ye)  # all-to-all back
+    def half(x, idx, topw, valid):
+        t = x.shape[0]
+        C = max(1, int(t * k / S * cfg.moe_capacity_factor))
+        onehot = jax.nn.one_hot(idx, S, dtype=jnp.int32) * valid[..., None]  # [t, k, S]
+        flat = onehot.reshape(t * k, S)
+        pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(t, k, S)
+        keep = (pos_in_expert < C).astype(x.dtype) * onehot.astype(x.dtype)
+        disp = keep[..., None] * jax.nn.one_hot(pos_in_expert, C, dtype=x.dtype)
+        comb = disp * topw[..., None, None].astype(x.dtype)
+        disp2 = disp.sum(1)  # [t, S, C]
+        comb2 = comb.sum(1)
+
+        xe = jnp.einsum("tec,td->ecd", disp2, x)  # all-to-all in, [S, C, D]
+        if matmul_impl is not None:
+            slot_counts = jnp.sum(disp2, axis=(0, 2)).astype(jnp.int32)  # [S]
+            gate_up = matmul_impl(xe, wi, slot_counts)
+            gate, up = jnp.split(gate_up, 2, axis=-1)
+            ye = matmul_impl(jax.nn.silu(gate) * up, wo, slot_counts)
+        else:
+            gate_up = jnp.einsum("ecd,edf->ecf", xe, wi)
+            gate, up = jnp.split(gate_up, 2, axis=-1)
+            ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, wo)
+        return jnp.einsum("tec,ecd->td", comb2, ye)  # all-to-all back
+
+    if cfg.moe_dbo and T % 2 == 0 and T >= 2:
+        h = T // 2
+        y = jnp.concatenate([
+            half(x[:h], idx[:h], topw[:h], valid[:h]),
+            half(x[h:], idx[h:], topw[h:], valid[h:]),
+        ])
+    else:
+        y = half(x, idx, topw, valid)
+    return y, counts
 
 
 # ---------------------------------------------------------------------------
@@ -241,11 +293,19 @@ def forward(
     page_tables: jax.Array,  # [B, max_pages]
     kv_lens: jax.Array,  # [B] cache length AFTER this step's tokens
     attn_impl=paged_attention,
-) -> tuple[jax.Array, jax.Array]:
+    moe_matmul_impl=None,
+    with_expert_counts: bool = False,
+) -> tuple[jax.Array, ...]:
     """Run tokens through the model, writing K/V into the paged cache.
 
     Serves both chunked prefill (T = chunk) and decode (T = 1): the engine packs
-    whatever fits. Returns (logits [B, T, vocab], updated cache).
+    whatever fits. Returns (logits [B, T, vocab], updated cache); with
+    ``with_expert_counts`` (MoE only) appends per-layer routed-token counts
+    [L, E] int32 for the EPLB load tracker.
+
+    EPLB mode: when ``params`` carries ``eplb_replica_slots``/``eplb_replica_counts``
+    (engine-injected, see engine's rebalance path), ``moe_wi``/``moe_wo`` are physical
+    slot weights and dispatch spreads tokens over replicas.
     """
     B, T = tokens.shape
     ps = cache.shape[3]
@@ -262,6 +322,8 @@ def forward(
         if cfg.is_moe
         else ("wi", "wo_mlp")
     )
+    if "eplb_replica_slots" in params:
+        stacked_keys += ("eplb_replica_slots", "eplb_replica_counts")
     layer_params = {k: params[k] for k in stacked_keys}
 
     def body(carry, scanned):
@@ -280,19 +342,31 @@ def forward(
 
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         if cfg.is_moe:
-            y = moe_block(cfg, h.reshape(B * T, -1), lp["router"], lp["moe_wi"], lp["moe_wo"])
+            eplb = (
+                (lp["eplb_replica_slots"], lp["eplb_replica_counts"])
+                if "eplb_replica_slots" in lp
+                else None
+            )
+            y, cnt = moe_block(
+                cfg, h.reshape(B * T, -1), lp["router"], lp["moe_wi"], lp["moe_wo"],
+                eplb=eplb, matmul_impl=moe_matmul_impl,
+                token_mask=(positions >= 0).reshape(B * T),
+            )
             y = y.reshape(B, T, -1)
             if cfg.moe_num_shared_experts:
                 y = y + swiglu(h, lp["shared_wi"], lp["shared_wo"])
         else:
+            cnt = jnp.zeros((0,), jnp.int32)
             y = swiglu(h, lp["wi"], lp["wo_mlp"])
         x = x + y
-        return (x, 0), cache_l
+        return (x, 0), (cache_l, cnt)
 
-    (x, _), new_cache = lax.scan(body, (x, 0), (layer_params, cache))
+    (x, _), (new_cache, expert_counts) = lax.scan(body, (x, 0), (layer_params, cache))
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), unembed.astype(jnp.float32))
+    if with_expert_counts:
+        return logits, new_cache, expert_counts
     return logits, new_cache
 
 
