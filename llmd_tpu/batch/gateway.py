@@ -1,0 +1,373 @@
+"""Batch Gateway — OpenAI Files + Batches API over the router.
+
+Parity: reference `llm-d/llm-d-batch-gateway` as specified in
+`docs/architecture/advanced/batch/batch-gateway.md:11-87` (SURVEY §2.6 A3):
+- REST surface: `/v1/files` (upload/fetch/content/delete) + `/v1/batches`
+  (create/get/list/cancel), OpenAI Batch schema.
+- Storage split: FS object store (S3 stand-in, tenant-hashed paths) +
+  SQLite metadata (PostgreSQL stand-in) + in-process priority queue ordered by
+  SLO priority (Redis sorted-set stand-in).
+- Processor: poll → ingest (validate JSONL, count, extract model) → per-model
+  workers bounded by global AND per-model concurrency caps → finalize (write
+  output/error files, terminal status).
+- Crash recovery: startup scan re-queues every non-terminal batch
+  (`batch-gateway.md:55-59`).
+- GC of aged terminal batches; tenant isolation via header + hashed paths;
+  authN at the batch route (bearer key), authZ left to the inference path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.batch.files import FileStore, validate_batch_input
+from llmd_tpu.batch.store import BatchRow, BatchStore
+
+TENANT_HEADER = "x-llm-d-tenant"  # reference: tenant from auth header
+
+
+def _window_seconds(window: str) -> float:
+    try:
+        if window.endswith("h"):
+            return float(window[:-1]) * 3600
+        if window.endswith("m"):
+            return float(window[:-1]) * 60
+        if window.endswith("s"):
+            return float(window[:-1])
+    except ValueError:
+        pass
+    return 24 * 3600
+
+
+@dataclass
+class BatchGatewayConfig:
+    target_url: str = "http://127.0.0.1:8000"  # the llm-d Router
+    files_root: str = "/tmp/llmd-batch-files"
+    store_path: str = ":memory:"
+    global_concurrency: int = 8     # cap across all models
+    per_model_concurrency: int = 4  # cap per model
+    poll_interval_s: float = 0.05
+    gc_interval_s: float = 3600.0
+    retention_s: float = 30 * 24 * 3600
+    api_key: Optional[str] = None   # authN at the batch route; None = open
+    request_timeout_s: float = 120.0
+
+
+class BatchGateway:
+    def __init__(self, cfg: BatchGatewayConfig, host: str = "127.0.0.1",
+                 port: int = 0) -> None:
+        self.cfg = cfg
+        self.host, self.port = host, port
+        self.files = FileStore(cfg.files_root)
+        self.store = BatchStore(cfg.store_path)
+        self._queue: asyncio.PriorityQueue = asyncio.PriorityQueue()
+        self._global_sem = asyncio.Semaphore(cfg.global_concurrency)
+        self._model_sems: dict[str, asyncio.Semaphore] = {}
+        self._cancel_requested: set[str] = set()
+        self._tasks: list[asyncio.Task] = []
+        self._runner: Optional[web.AppRunner] = None
+        self._session: Optional[aiohttp.ClientSession] = None
+        self.stats = {"ingested": 0, "requests_done": 0, "requests_failed": 0,
+                      "recovered": 0, "gc_deleted": 0}
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        # crash recovery scan: everything non-terminal goes back on the queue
+        for row in self.store.recovery_scan():
+            self.stats["recovered"] += 1
+            self._enqueue(row)
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_post("/v1/files", self._upload_file)
+        app.router.add_get("/v1/files/{file_id}", self._get_file)
+        app.router.add_get("/v1/files/{file_id}/content", self._get_file_content)
+        app.router.add_delete("/v1/files/{file_id}", self._delete_file)
+        app.router.add_post("/v1/batches", self._create_batch)
+        app.router.add_get("/v1/batches", self._list_batches)
+        app.router.add_get("/v1/batches/{batch_id}", self._get_batch)
+        app.router.add_post("/v1/batches/{batch_id}/cancel", self._cancel_batch)
+        app.router.add_get("/health", lambda r: web.json_response({"status": "ok"}))
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        loop = asyncio.get_running_loop()
+        self._tasks = [loop.create_task(self._process_loop()),
+                       loop.create_task(self._gc_loop())]
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        if self._runner:
+            await self._runner.cleanup()
+        if self._session:
+            await self._session.close()
+
+    # ------------------------------------------------------------- HTTP: auth
+    def _tenant(self, request: web.Request) -> Optional[str]:
+        if self.cfg.api_key is not None:
+            auth = request.headers.get("Authorization", "")
+            if auth != f"Bearer {self.cfg.api_key}":
+                return None
+        return request.headers.get(TENANT_HEADER, "default")
+
+    # ------------------------------------------------------------ HTTP: files
+    async def _upload_file(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        filename, purpose, data = "file.jsonl", "batch", b""
+        if request.content_type.startswith("multipart/"):
+            async for part in await request.multipart():
+                if part.name == "file":
+                    filename = part.filename or filename
+                    data = await part.read(decode=False)
+                elif part.name == "purpose":
+                    purpose = (await part.read(decode=False)).decode()
+        else:
+            data = await request.read()
+            filename = request.query.get("filename", filename)
+            purpose = request.query.get("purpose", purpose)
+        if not data:
+            return web.json_response({"error": "empty file"}, status=400)
+        meta = self.files.put(tenant, filename, data, purpose)
+        return web.json_response(meta.to_openai())
+
+    async def _get_file(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        meta = self.files.get_meta(tenant, request.match_info["file_id"])
+        if meta is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(meta.to_openai())
+
+    async def _get_file_content(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        data = self.files.get_content(tenant, request.match_info["file_id"])
+        if data is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.Response(body=data, content_type="application/octet-stream")
+
+    async def _delete_file(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        ok = self.files.delete(tenant, request.match_info["file_id"])
+        return web.json_response({"deleted": ok,
+                                  "id": request.match_info["file_id"]},
+                                 status=200 if ok else 404)
+
+    # ---------------------------------------------------------- HTTP: batches
+    async def _create_batch(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        input_file_id = body.get("input_file_id", "")
+        endpoint = body.get("endpoint", "/v1/completions")
+        if self.files.get_meta(tenant, input_file_id) is None:
+            return web.json_response({"error": "input file not found"}, status=404)
+        row = self.store.create(
+            tenant, input_file_id, endpoint,
+            completion_window=body.get("completion_window", "24h"),
+            metadata=body.get("metadata") or {},
+            priority=int(body.get("priority", 0)),
+        )
+        self._enqueue(row)
+        return web.json_response(row.to_openai())
+
+    async def _get_batch(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        row = self.store.get(request.match_info["batch_id"], tenant)
+        if row is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(row.to_openai())
+
+    async def _list_batches(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        rows = self.store.list(tenant)
+        return web.json_response({"object": "list",
+                                  "data": [r.to_openai() for r in rows]})
+
+    async def _cancel_batch(self, request: web.Request):
+        tenant = self._tenant(request)
+        if tenant is None:
+            return web.json_response({"error": "unauthorized"}, status=401)
+        row = self.store.get(request.match_info["batch_id"], tenant)
+        if row is None:
+            return web.json_response({"error": "not found"}, status=404)
+        if row.status in ("validating", "in_progress"):
+            row.status = "cancelling"
+            self.store.update(row)
+            self._cancel_requested.add(row.id)
+        return web.json_response(row.to_openai())
+
+    # -------------------------------------------------------------- processor
+    def _enqueue(self, row: BatchRow) -> None:
+        # SLO-priority sorted set: higher priority first, FIFO within a level
+        self._queue.put_nowait((-row.priority, row.created_at, row.id))
+
+    def _model_sem(self, model: str) -> asyncio.Semaphore:
+        if model not in self._model_sems:
+            self._model_sems[model] = asyncio.Semaphore(self.cfg.per_model_concurrency)
+        return self._model_sems[model]
+
+    async def _process_loop(self) -> None:
+        running: set[asyncio.Task] = set()
+        while True:
+            _, _, batch_id = await self._queue.get()
+            row = self.store.get(batch_id)
+            if row is None:
+                continue
+            if row.status == "cancelling":
+                # covers both live cancels and 'cancelling' rows found by the
+                # recovery scan (the in-memory cancel set dies with the process)
+                row.status = "cancelled"
+                self.store.update(row)
+                self._cancel_requested.discard(row.id)
+                continue
+            # 'finalizing' re-runs after a crash mid-finalize (recovery scan);
+            # _run_batch resets counts so the re-run can't double-count
+            if row.status not in ("validating", "in_progress", "finalizing"):
+                continue
+            t = asyncio.get_running_loop().create_task(self._run_batch(row))
+            running.add(t)
+            t.add_done_callback(running.discard)
+
+    async def _run_batch(self, row: BatchRow) -> None:
+        data = self.files.get_content(row.tenant, row.input_file_id)
+        if data is None:
+            row.status, row.errors = "failed", json.dumps(
+                [{"message": "input file disappeared"}])
+            self.store.update(row)
+            return
+        reqs, errors = validate_batch_input(data)
+        if errors:
+            # surfaced even when some lines are valid (lenient ingest: valid
+            # lines run, rejects are recorded on the batch object)
+            row.errors = json.dumps([{"message": e} for e in errors[:100]])
+        if not reqs:
+            row.status = "failed"
+            self.store.update(row)
+            return
+        row.total = len(reqs)
+        row.completed = row.failed = 0  # reset: recovery may re-run this batch
+        row.model = next((r["body"].get("model", "") for r in reqs), "")
+        row.status = "in_progress"
+        self.store.update(row)
+        self.stats["ingested"] += 1
+
+        deadline = row.created_at + _window_seconds(row.completion_window)
+        results: list[Optional[dict]] = [None] * len(reqs)
+        cancelled = False
+
+        async def one(i: int, req: dict) -> None:
+            nonlocal cancelled
+            model = req["body"].get("model", row.model)
+            async with self._global_sem, self._model_sem(model):
+                # cancellation/expiry checked under the semaphore — every queued
+                # request re-evaluates right before its dispatch slot
+                if cancelled or row.id in self._cancel_requested:
+                    cancelled = True
+                    return
+                if time.time() > deadline:
+                    results[i] = {"error": {"message": "completion window expired"}}
+                    return
+                results[i] = await self._dispatch(row, req)
+
+        # per-model workers: bounded fan-out under both caps
+        await asyncio.gather(*(one(i, r) for i, r in enumerate(reqs)))
+
+        if cancelled:
+            row.status = "cancelled"
+            self._cancel_requested.discard(row.id)
+            self.store.update(row)
+            return
+        await self._finalize(row, reqs, results)
+
+    async def _dispatch(self, row: BatchRow, req: dict) -> dict:
+        url = f"{self.cfg.target_url}{req.get('url', row.endpoint)}"
+        try:
+            async with self._session.post(
+                url, json=req["body"],
+                headers={TENANT_HEADER: row.tenant,
+                         "x-llm-d-inference-objective": "batch"},
+                timeout=aiohttp.ClientTimeout(total=self.cfg.request_timeout_s),
+            ) as resp:
+                body = await resp.json(content_type=None)
+                if resp.status == 200:
+                    self.stats["requests_done"] += 1
+                    return {"status_code": 200, "body": body}
+                self.stats["requests_failed"] += 1
+                return {"status_code": resp.status, "body": body,
+                        "error": {"message": f"HTTP {resp.status}"}}
+        except Exception as exc:
+            self.stats["requests_failed"] += 1
+            return {"error": {"message": f"{type(exc).__name__}: {exc}"}}
+
+    async def _finalize(self, row: BatchRow, reqs: list[dict],
+                        results: list[Optional[dict]]) -> None:
+        row.status = "finalizing"
+        self.store.update(row)
+        out_lines, err_lines = [], []
+        for req, res in zip(reqs, results):
+            res = res or {"error": {"message": "not executed"}}
+            line = {"id": f"batch_req_{uuid.uuid4().hex[:16]}",
+                    "custom_id": req["custom_id"],
+                    "response": ({"status_code": res["status_code"],
+                                  "body": res["body"]}
+                                 if "status_code" in res else None),
+                    "error": res.get("error")}
+            if res.get("status_code") == 200:
+                row.completed += 1
+                out_lines.append(line)
+            else:
+                row.failed += 1
+                err_lines.append(line)
+        if out_lines:
+            meta = self.files.put(
+                row.tenant, f"{row.id}_output.jsonl",
+                "\n".join(json.dumps(l) for l in out_lines).encode(),
+                purpose="batch_output")
+            row.output_file_id = meta.id
+        if err_lines:
+            meta = self.files.put(
+                row.tenant, f"{row.id}_errors.jsonl",
+                "\n".join(json.dumps(l) for l in err_lines).encode(),
+                purpose="batch_output")
+            row.error_file_id = meta.id
+        row.status = "completed" if row.completed or not row.failed else "failed"
+        self.store.update(row)
+
+    async def _gc_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.cfg.gc_interval_s)
+            self.stats["gc_deleted"] += self.store.gc(self.cfg.retention_s)

@@ -203,22 +203,32 @@ class DPAsyncEngine(AsyncLLMEngine):
 
     def __init__(self, engine: LLMEngine, worker: DPWorkerSync,
                  idle_sleep_s: float = 0.002,
-                 register_attempt_timeout_s: float = 2.0) -> None:
+                 register_attempt_timeout_s: float = 2.0,
+                 register_retry_interval_s: float = 5.0) -> None:
         super().__init__(engine, idle_sleep_s=idle_sleep_s)
         self.worker = worker
         self.steps = 0
         self.empty_steps = 0  # wave-joined steps with no local work
         self.register_attempt_timeout_s = register_attempt_timeout_s
+        self.register_retry_interval_s = register_retry_interval_s
         self.register_failures = 0
         self.registered = False
+        self._next_register = 0.0
 
     def _try_register(self) -> None:
+        # paced: a blocked register attempt (dead leader, slow peer) costs up to
+        # attempt_timeout once per retry interval — solo serving keeps full rate
+        # in between instead of stalling seconds per step
+        now = time.monotonic()
+        if now < self._next_register:
+            return
         try:
             self.worker.register(barrier_timeout_s=self.register_attempt_timeout_s)
             self.registered = True
         except Exception:
             self.register_failures += 1
             self.worker.close()
+            self._next_register = time.monotonic() + self.register_retry_interval_s
 
     def _run(self) -> None:  # overrides the base loop
         while not self._stop.is_set():

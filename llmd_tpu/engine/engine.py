@@ -118,7 +118,6 @@ class LLMEngine:
         mesh = self.mesh
         attn = self._select_attn_impl()
         moe_impl = self._select_moe_impl()
-        track = self._eplb is not None
 
         def _bind(x, *axes):
             """GSPMD sharding constraint by mesh axis names (no-op off-mesh)."""
@@ -132,16 +131,11 @@ class LLMEngine:
             # sequence-parallel long-context prefill: chunk dim sharded over sp
             tokens = _bind(tokens, "sp")
             positions = _bind(positions, "sp")
-            out = forward(
+            logits, cache, cnt = forward(
                 cfg, params, cache, tokens[None], positions[None], page_table[None],
                 kv_len[None], attn_impl=attn, moe_matmul_impl=moe_impl,
-                with_expert_counts=track,
             )
-            if track:
-                logits, cache, cnt = out
-                return logits[0], cache, cnt
-            logits, cache = out
-            return logits[0], cache
+            return logits[0], cache, cnt
 
         def _decode(params, cache, tokens, positions, page_tables, kv_lens):
             # decode batch sharded over dp; heads/experts sharding rides on params
@@ -149,16 +143,11 @@ class LLMEngine:
             positions = _bind(positions, "dp")
             page_tables = _bind(page_tables, "dp", None)
             kv_lens = _bind(kv_lens, "dp")
-            out = forward(
+            logits, cache, cnt = forward(
                 cfg, params, cache, tokens[:, None], positions[:, None], page_tables,
                 kv_lens, attn_impl=attn, moe_matmul_impl=moe_impl,
-                with_expert_counts=track,
             )
-            if track:
-                logits, cache, cnt = out
-                return logits[:, 0], cache, cnt
-            logits, cache = out
-            return logits[:, 0], cache
+            return logits[:, 0], cache, cnt
 
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
                           temp, top_k, top_p, key, active_mask):
@@ -171,14 +160,10 @@ class LLMEngine:
 
             def body(carry, _):
                 cache, toks, pos, lens, key = carry
-                out = forward(
+                logits, cache, cnt = forward(
                     cfg, params, cache, toks[:, None], pos[:, None], page_tables, lens,
-                    attn_impl=attn, moe_matmul_impl=moe_impl, with_expert_counts=track,
+                    attn_impl=attn, moe_matmul_impl=moe_impl,
                 )
-                if track:
-                    logits, cache, cnt = out
-                else:
-                    (logits, cache), cnt = out, jnp.zeros((0,), jnp.int32)
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens(logits[:, 0].astype(jnp.float32), sub, temp, top_k, top_p)
                 nxt = jnp.where(active_mask, nxt, 0)
@@ -190,9 +175,7 @@ class LLMEngine:
                 body, (cache, tokens, positions, kv_lens, key), None,
                 length=engine_cfg.decode_steps,
             )
-            if track:
-                return toks_out, cache, cnts.sum(0)  # [k, B], cache, [L, E]
-            return toks_out, cache  # [k, B]
+            return toks_out, cache, cnts.sum(0)  # [k, B], cache, [L, E]
 
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
         self._prefill_fn = jax.jit(_prefill, **donate)
@@ -555,15 +538,12 @@ class LLMEngine:
         pt = np.full((self.cfg.max_pages_per_seq,), -1, np.int32)
         pt[: len(seq.pages)] = seq.pages
 
-        out = self._prefill_fn(
+        logits, self.cache, cnt = self._prefill_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(pt), jnp.asarray(start + n, jnp.int32),
         )
         if self._eplb is not None:
-            logits, self.cache, cnt = out
             self._eplb_record(cnt)
-        else:
-            logits, self.cache = out
         seq.num_computed = start + n
         seq.maybe_commit_blocks(self.alloc)
         self.stats.total_prefill_tokens += n
@@ -618,15 +598,12 @@ class LLMEngine:
             lens[i] = len(s.token_ids)
 
         if k == 1:
-            out = self._decode_fn(
+            logits, self.cache, cnt = self._decode_fn(
                 self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
                 jnp.asarray(pts), jnp.asarray(lens),
             )
             if self._eplb is not None:
-                logits, self.cache, cnt = out
                 self._eplb_record(cnt)
-            else:
-                logits, self.cache = out
             for s in active:
                 s.num_computed = len(s.token_ids)
                 s.maybe_commit_blocks(self.alloc)
@@ -646,16 +623,13 @@ class LLMEngine:
             temp[s.slot], tk[s.slot], tp[s.slot] = sp.temperature, sp.top_k, sp.top_p
             mask[s.slot] = True
         self._key, sub = jax.random.split(self._key)
-        out = self._decode_multi_fn(
+        toks_out, self.cache, cnt = self._decode_multi_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(tk),
             jnp.asarray(tp), sub, jnp.asarray(mask),
         )
         if self._eplb is not None:
-            toks_out, self.cache, cnt = out
             self._eplb_record(cnt)
-        else:
-            toks_out, self.cache = out
         toks_out = np.asarray(toks_out)  # [k, B]
         now = time.monotonic()
         for s in active:

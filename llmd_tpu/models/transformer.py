@@ -294,14 +294,13 @@ def forward(
     kv_lens: jax.Array,  # [B] cache length AFTER this step's tokens
     attn_impl=paged_attention,
     moe_matmul_impl=None,
-    with_expert_counts: bool = False,
-) -> tuple[jax.Array, ...]:
+) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run tokens through the model, writing K/V into the paged cache.
 
     Serves both chunked prefill (T = chunk) and decode (T = 1): the engine packs
-    whatever fits. Returns (logits [B, T, vocab], updated cache); with
-    ``with_expert_counts`` (MoE only) appends per-layer routed-token counts
-    [L, E] int32 for the EPLB load tracker.
+    whatever fits. Returns (logits [B, T, vocab], updated cache, expert_counts)
+    where expert_counts is the per-layer routed-token stat [L, E] int32 feeding
+    the EPLB load tracker ([L, 0] for dense models — callers ignore it freely).
 
     EPLB mode: when ``params`` carries ``eplb_replica_slots``/``eplb_replica_counts``
     (engine-injected, see engine's rebalance path), ``moe_wi``/``moe_wo`` are physical
@@ -365,9 +364,7 @@ def forward(
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), unembed.astype(jnp.float32))
-    if with_expert_counts:
-        return logits, new_cache, expert_counts
-    return logits, new_cache
+    return logits, new_cache, expert_counts
 
 
 def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> jax.Array:
