@@ -223,14 +223,19 @@ class PrometheusSaturationGate(DispatchGate):
 
 class PrometheusBudgetGate(PrometheusSaturationGate):
     """Like saturation, but spends a budget metric: dispatch allowed while the
-    metric (e.g. spare capacity) is ABOVE threshold."""
+    metric (e.g. spare capacity) is ABOVE threshold. With ``fail_open=False`` an
+    unreachable metrics endpoint keeps the gate closed (a stale last_value from
+    an earlier successful poll still counts as a reading)."""
 
     async def acquire(self) -> None:
-        await self._poll_once()
-        # budget semantics: closed while value <= threshold
-        while self.last_value is not None and self.last_value <= self.threshold:
-            await asyncio.sleep(self.poll)
+        while True:
             await self._poll_once()
+            if self.last_value is None:  # no reading ever obtained
+                if self.fail_open:
+                    return
+            elif self.last_value > self.threshold:
+                return
+            await asyncio.sleep(self.poll)
 
 
 GATE_REGISTRY: dict[str, Callable[..., DispatchGate]] = {

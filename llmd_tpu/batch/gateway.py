@@ -258,9 +258,26 @@ class BatchGateway:
             # _run_batch resets counts so the re-run can't double-count
             if row.status not in ("validating", "in_progress", "finalizing"):
                 continue
-            t = asyncio.get_running_loop().create_task(self._run_batch(row))
+            t = asyncio.get_running_loop().create_task(self._run_batch_safe(row))
             running.add(t)
             t.add_done_callback(running.discard)
+
+    async def _run_batch_safe(self, row: BatchRow) -> None:
+        """A crashed batch run must still reach a terminal status — an exception
+        swallowed by the fire-and-forget task would strand it non-terminal with
+        clients polling forever."""
+        try:
+            await self._run_batch(row)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            try:
+                row.status = "failed"
+                row.errors = json.dumps(
+                    [{"message": f"processor error: {type(exc).__name__}: {exc}"}])
+                self.store.update(row)
+            except Exception:
+                pass  # metadata store down too: recovery scan re-runs it on restart
 
     async def _run_batch(self, row: BatchRow) -> None:
         data = self.files.get_content(row.tenant, row.input_file_id)

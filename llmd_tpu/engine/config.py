@@ -49,6 +49,9 @@ class EngineConfig:
     # Expert-parallel load balancing with redundant experts (wide-ep --enable-eplb
     # {window_size, step_interval, num_redundant_experts}); None = disabled.
     eplb: Optional[EPLBConfig] = None
+    # LoRA multi-adapter serving (model-servers.md:55-75); None = disabled.
+    # Imported lazily to avoid a models<->engine import cycle at module load.
+    lora: "object | None" = None  # llmd_tpu.models.lora.LoRAConfig
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -114,10 +114,29 @@ class LLMEngine:
         if engine_cfg.eplb is not None and model_cfg.is_moe:
             self._init_eplb()
 
+        self.lora_registry = None
+        self._lora_params: dict[str, jax.Array] = {}
+        if engine_cfg.lora is not None:
+            from llmd_tpu.models.lora import LoRARegistry, init_lora_params
+
+            self.lora_registry = LoRARegistry(engine_cfg.lora.max_adapters)
+            # a displaced idle adapter's cached KV is invalid the moment its
+            # slot is reassigned
+            self.lora_registry.on_evict = lambda name: self.alloc.purge_lora(name)
+            self._lora_params = init_lora_params(model_cfg, engine_cfg.lora)
+            if self.mesh is not None:
+                from llmd_tpu.models.lora import lora_param_logical_axes
+                from llmd_tpu.parallel.mesh import shard_pytree
+
+                self._lora_params = shard_pytree(
+                    self._lora_params, self.mesh, lora_param_logical_axes(model_cfg))
+
         cfg = model_cfg
         mesh = self.mesh
         attn = self._select_attn_impl()
         moe_impl = self._select_moe_impl()
+        use_lora = self.lora_registry is not None
+        lora_scale = engine_cfg.lora.scale if use_lora else 1.0
 
         def _bind(x, *axes):
             """GSPMD sharding constraint by mesh axis names (no-op off-mesh)."""
@@ -127,17 +146,18 @@ class LLMEngine:
 
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))
 
-        def _prefill(params, cache, tokens, positions, page_table, kv_len):
+        def _prefill(params, cache, tokens, positions, page_table, kv_len, lora_idx):
             # sequence-parallel long-context prefill: chunk dim sharded over sp
             tokens = _bind(tokens, "sp")
             positions = _bind(positions, "sp")
             logits, cache, cnt = forward(
                 cfg, params, cache, tokens[None], positions[None], page_table[None],
                 kv_len[None], attn_impl=attn, moe_matmul_impl=moe_impl,
+                lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
             )
             return logits[0], cache, cnt
 
-        def _decode(params, cache, tokens, positions, page_tables, kv_lens):
+        def _decode(params, cache, tokens, positions, page_tables, kv_lens, lora_idx):
             # decode batch sharded over dp; heads/experts sharding rides on params
             tokens = _bind(tokens, "dp")
             positions = _bind(positions, "dp")
@@ -146,11 +166,12 @@ class LLMEngine:
             logits, cache, cnt = forward(
                 cfg, params, cache, tokens[:, None], positions[:, None], page_tables,
                 kv_lens, attn_impl=attn, moe_matmul_impl=moe_impl,
+                lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
             )
             return logits[:, 0], cache, cnt
 
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
-                          temp, top_k, top_p, key, active_mask):
+                          temp, top_k, top_p, key, active_mask, lora_idx):
             """k decode iterations fused on-device (lax.scan): feed sampled token back
             each step; one host round-trip per k tokens instead of per token."""
             tokens = _bind(tokens, "dp")
@@ -163,6 +184,7 @@ class LLMEngine:
                 logits, cache, cnt = forward(
                     cfg, params, cache, toks[:, None], pos[:, None], page_tables, lens,
                     attn_impl=attn, moe_matmul_impl=moe_impl,
+                    lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
                 )
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens(logits[:, 0].astype(jnp.float32), sub, temp, top_k, top_p)
@@ -294,10 +316,69 @@ class LLMEngine:
         self.stats.eplb_rebalances += 1
 
     def _run_params(self) -> dict[str, jax.Array]:
-        """Params seen by the step programs: physical expert weights under EPLB."""
-        if self._eplb is None:
+        """Params seen by the step programs: base weights, plus physical expert
+        weights under EPLB, plus the LoRA adapter bank when enabled."""
+        if self._eplb is None and not self._lora_params:
             return self.params
-        return {**self.params, **self._eplb_params}
+        merged = dict(self.params)
+        if self._eplb is not None:
+            merged.update(self._eplb_params)
+        merged.update(self._lora_params)
+        return merged
+
+    # ----------------------------------------------------------------- LoRA
+    # Dynamic adapter serving (model-servers.md:55-75; adapter-rollout.md:11-31).
+    # Loading writes one slot of the fixed-shape device bank — step programs
+    # never recompile as adapters come and go.
+    def _lora_slot(self, seq: "Sequence") -> int:
+        if self.lora_registry is None:
+            return 0
+        return self.lora_registry.slot_of(seq.lora_id)
+
+    def load_lora_adapter(self, name: str, weights: Optional[dict] = None,
+                          seed: Optional[int] = None) -> int:
+        """Install an adapter into a free slot. ``weights`` maps
+        lora_{A,B}_{target} -> [L, ...] arrays; None generates a random test
+        double (the filesystem-resolver path loads real weights and calls this)."""
+        if self.lora_registry is None:
+            raise RuntimeError("engine built without EngineConfig.lora")
+        from llmd_tpu.models.lora import make_adapter_weights
+
+        if self.lora_registry.has(name):
+            # re-load under the same name = new weights: KV computed under the
+            # old weights must never prefix-match again (hashes carry only the
+            # adapter NAME, core/kv_events.py)
+            self.alloc.purge_lora(name)
+        slot = self.lora_registry.assign(name)
+        if weights is None:
+            weights = make_adapter_weights(
+                self.model_cfg, self.cfg.lora,
+                jax.random.PRNGKey(seed if seed is not None else (hash(name) & 0x7FFFFFFF)))
+        for key in self._lora_params:  # zero first: partial weight sets must not
+            if key not in weights:     # inherit a displaced adapter's leftovers
+                self._lora_params[key] = self._lora_params[key].at[:, slot].set(0)
+        for key, w in weights.items():
+            if key not in self._lora_params:
+                raise KeyError(f"unknown LoRA param {key!r}")
+            self._lora_params[key] = self._lora_params[key].at[:, slot].set(
+                jnp.asarray(w, self._lora_params[key].dtype))
+        return slot
+
+    def unload_lora_adapter(self, name: str) -> bool:
+        if self.lora_registry is None:
+            return False
+        if self.lora_registry.running.get(name) or self.lora_registry.waiting.get(name):
+            # in-flight guard: freeing the slot mid-generation would silently
+            # switch live sequences to base weights (and let the slot be reused)
+            raise RuntimeError(f"adapter {name!r} has in-flight requests")
+        slot = self.lora_registry.remove(name)
+        if slot is None:
+            return False
+        for key in self._lora_params:  # zero the slot: it is the null adapter again
+            self._lora_params[key] = self._lora_params[key].at[:, slot].set(0)
+        # stale-KV defense: blocks computed under this adapter must not be reused
+        self.alloc.purge_lora(name)
+        return True
 
     def _eplb_record(self, cnt: jax.Array) -> None:
         self._eplb_tracker.record(np.asarray(cnt))
@@ -333,6 +414,10 @@ class LLMEngine:
                 f"prompt needs more KV pages than the whole pool "
                 f"({len(token_ids)} tokens, {self.cfg.num_pages} pages × {ps})"
             )
+        if lora_id and self.lora_registry is not None and not self.lora_registry.has(lora_id):
+            # vLLM returns 404 for unknown adapters; silently serving base
+            # weights would also poison the prefix cache under this name
+            raise ValueError(f"unknown LoRA adapter {lora_id!r}")
         seq = Sequence(
             request_id=request_id, token_ids=list(token_ids), prompt_len=len(token_ids),
             max_tokens=sampling.max_tokens, sampling=sampling, lora_id=lora_id,
@@ -340,6 +425,8 @@ class LLMEngine:
         )
         self.seqs[request_id] = seq
         self.waiting.append(seq)
+        if self.lora_registry is not None:
+            self.lora_registry.on_waiting(lora_id)
 
     def abort(self, request_id: str) -> None:
         seq = self.seqs.pop(request_id, None)
@@ -347,6 +434,12 @@ class LLMEngine:
             return
         if seq.slot >= 0:
             self.running[seq.slot] = None
+            if self.lora_registry is not None:
+                self.lora_registry.on_finished(seq.lora_id)
+        elif self.lora_registry is not None and seq.lora_id:
+            # aborted while queued: rewind the waiting counter
+            if self.lora_registry.waiting.get(seq.lora_id, 0) > 0:
+                self.lora_registry.waiting[seq.lora_id] -= 1
         try:
             self.waiting.remove(seq)
         except ValueError:
@@ -417,6 +510,8 @@ class LLMEngine:
             seq.slot = slot
             self.running[slot] = seq
             self.waiting.popleft()
+            if self.lora_registry is not None:
+                self.lora_registry.on_running(seq.lora_id)
 
     def _reload_offloaded(self, seq: Sequence, keys: list[int], n_hbm: int,
                           n_offload: int) -> list[int]:
@@ -465,6 +560,9 @@ class LLMEngine:
         victim = max(victims, key=lambda s: s.arrival_time)
         self.running[victim.slot] = None
         victim.slot = -1
+        if self.lora_registry is not None:  # back to waiting: keep counters true
+            self.lora_registry.on_finished(victim.lora_id)
+            self.lora_registry.on_waiting(victim.lora_id)
         self._free_seq(victim)
         victim.num_computed = 0
         victim.block_hashes = []
@@ -541,6 +639,7 @@ class LLMEngine:
         logits, self.cache, cnt = self._prefill_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(pt), jnp.asarray(start + n, jnp.int32),
+            jnp.asarray([self._lora_slot(seq)], jnp.int32),
         )
         if self._eplb is not None:
             self._eplb_record(cnt)
@@ -590,17 +689,19 @@ class LLMEngine:
         pos = np.full((B,), -1, np.int32)
         pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
         lens = np.zeros((B,), np.int32)
+        lora_idx = np.zeros((B,), np.int32)
         for s in active:
             i = s.slot
             toks[i] = s.token_ids[-1]
             pos[i] = len(s.token_ids) - 1
             pts[i, : len(s.pages)] = s.pages
             lens[i] = len(s.token_ids)
+            lora_idx[i] = self._lora_slot(s)
 
         if k == 1:
             logits, self.cache, cnt = self._decode_fn(
                 self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
-                jnp.asarray(pts), jnp.asarray(lens),
+                jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(lora_idx),
             )
             if self._eplb is not None:
                 self._eplb_record(cnt)
@@ -610,9 +711,9 @@ class LLMEngine:
             self.stats.total_decode_tokens += len(active)
             self._sample_and_append(active, logits, slot_indexed=True)
             return
-        self._step_decode_multi(active, toks, pos, pts, lens, k)
+        self._step_decode_multi(active, toks, pos, pts, lens, lora_idx, k)
 
-    def _step_decode_multi(self, active, toks, pos, pts, lens, k: int) -> None:
+    def _step_decode_multi(self, active, toks, pos, pts, lens, lora_idx, k: int) -> None:
         B = self.cfg.max_batch_size
         temp = np.zeros((B,), np.float32)
         tk = np.zeros((B,), np.int32)
@@ -626,7 +727,7 @@ class LLMEngine:
         toks_out, self.cache, cnt = self._decode_multi_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(tk),
-            jnp.asarray(tp), sub, jnp.asarray(mask),
+            jnp.asarray(tp), sub, jnp.asarray(mask), jnp.asarray(lora_idx),
         )
         if self._eplb is not None:
             self._eplb_record(cnt)
@@ -663,6 +764,8 @@ class LLMEngine:
         if seq.slot >= 0:
             self.running[seq.slot] = None
             seq.slot = -1
+            if self.lora_registry is not None:
+                self.lora_registry.on_finished(seq.lora_id)
         self._free_seq(seq)
         self.seqs.pop(seq.request_id, None)
 

@@ -32,6 +32,7 @@ from llmd_tpu.core.kv_events import (
 class PageInfo:
     refs: int = 0
     block_hash: Optional[int] = None  # set once the page holds a complete, hashed block
+    lora_id: Optional[str] = None     # adapter the block was computed under
 
 
 class PageAllocator:
@@ -132,6 +133,7 @@ class PageAllocator:
             # the cached/lru invariant (one page per hash).
             return
         info.block_hash = block_hash
+        info.lora_id = lora_id
         self.cached[block_hash] = page_id
         self._emit([
             BlockStored(
@@ -172,6 +174,27 @@ class PageAllocator:
         if pairs:
             self._emit([BlockRemoved(block_hashes=[h for h, _ in pairs], medium=self.medium)])
         return pairs
+
+    def purge_lora(self, lora_id: str) -> int:
+        """Invalidate every cached block computed under an adapter (called at
+        unload — the slot's weights are gone, so its KV must never be reused;
+        a same-named adapter loaded later would otherwise serve stale KV)."""
+        removed: list[int] = []
+        for h, pid in list(self.cached.items()):
+            info = self.pages.get(pid)
+            if info is None or info.lora_id != lora_id:
+                continue
+            del self.cached[h]
+            if h in self.lru:  # evictable → page returns to the free list
+                self.lru.pop(h)
+                del self.pages[pid]
+                self.free.append(pid)
+            else:  # in use by a live sequence: keeps serving it, never re-matched
+                info.block_hash = None
+            removed.append(h)
+        if removed:
+            self._emit([BlockRemoved(block_hashes=removed, medium=self.medium)])
+        return len(removed)
 
     def clear(self) -> None:
         self.free = deque(range(self.num_pages))

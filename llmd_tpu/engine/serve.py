@@ -37,6 +37,10 @@ def main() -> None:
                     help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
     ap.add_argument("--offload-fs-path", default=None,
                     help="FS tier below the CPU tier (llmd_fs_backend path)")
+    ap.add_argument("--enable-lora", action="store_true",
+                    help="enable dynamic LoRA adapter serving")
+    ap.add_argument("--max-loras", type=int, default=8)
+    ap.add_argument("--max-lora-rank", type=int, default=8)
     ap.add_argument("--cpu", action="store_true", help="force CPU platform (dev)")
     args = ap.parse_args()
 
@@ -62,6 +66,11 @@ def main() -> None:
         role=args.role, cpu_offload_pages=args.cpu_offload_pages,
         offload_fs_path=args.offload_fs_path,
     )
+    if args.enable_lora:
+        from llmd_tpu.models.lora import LoRAConfig
+
+        engine_cfg.lora = LoRAConfig(max_adapters=args.max_loras,
+                                     rank=args.max_lora_rank)
     server = EngineServer(
         model_cfg, engine_cfg,
         model_name=args.served_model_name or f"llmd-tpu/{args.model}",

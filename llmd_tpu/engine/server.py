@@ -135,6 +135,8 @@ class EngineServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
         app.router.add_get("/v1/models", self._models)
+        app.router.add_post("/v1/load_lora_adapter", self._load_lora)
+        app.router.add_post("/v1/unload_lora_adapter", self._unload_lora)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -216,6 +218,14 @@ class EngineServer:
         created = int(time.time())
         model = body.get("model", self.model_name)
         lora_id = body.get("lora_adapter")
+        # vLLM semantics: requesting a loaded adapter's name as the model routes
+        # to that adapter (adapter-rollout.md canary flow relies on this)
+        reg = self.engine.lora_registry
+        if lora_id is None and reg is not None and reg.has(model):
+            lora_id = model
+        if lora_id is not None and reg is not None and not reg.has(lora_id):
+            return web.json_response(
+                {"error": {"message": f"unknown LoRA adapter {lora_id!r}"}}, status=404)
 
         ktp = KVTransferParams.from_dict(body.get("kv_transfer_params"))
         if ktp.do_remote_prefill and self.transfer_client is not None:
@@ -321,6 +331,13 @@ class EngineServer:
             f"llmd_tpu:preemptions_total {s.total_preemptions}",
             f"llmd_tpu:requests_total {self.request_count}",
         ]
+        if self.engine.lora_registry is not None:
+            info = self.engine.lora_registry.metrics_info()
+            lines.append(
+                'vllm:lora_requests_info{{max_lora="{max_lora}",'
+                'running_lora_adapters="{running_lora_adapters}",'
+                'waiting_lora_adapters="{waiting_lora_adapters}"}} 1'.format(**info)
+            )
         if self.transfer_source is not None:
             ts = self.transfer_source.stats
             lines += [
@@ -345,6 +362,61 @@ class EngineServer:
         return web.json_response({"status": "ok"})
 
     async def _models(self, request: web.Request):
-        return web.json_response(
-            {"object": "list", "data": [{"id": self.model_name, "object": "model"}]}
-        )
+        data = [{"id": self.model_name, "object": "model"}]
+        if self.engine.lora_registry is not None:  # adapters list as models (vLLM)
+            data += [{"id": name, "object": "model", "parent": self.model_name}
+                     for name in sorted(self.engine.lora_registry.slots)]
+        return web.json_response({"object": "list", "data": data})
+
+    async def _load_lora(self, request: web.Request):
+        """POST /v1/load_lora_adapter {lora_name, lora_path?} (vLLM runtime-LoRA
+        API; VLLM_ALLOW_RUNTIME_LORA_UPDATING equivalent is always-on here)."""
+        if self.engine.lora_registry is None:
+            return web.json_response(
+                {"error": "LoRA serving disabled (EngineConfig.lora unset)"}, status=400)
+        try:
+            body = await request.json()
+            name = body["lora_name"]
+        except Exception:
+            return web.json_response({"error": "lora_name required"}, status=400)
+        path = body.get("lora_path")
+
+        def _load_and_install() -> int:
+            weights = None
+            if path:  # filesystem resolver: npz with lora_{A,B}_{target} arrays
+                import numpy as _np
+
+                with _np.load(path) as z:  # in executor: big files must not
+                    weights = {k: z[k] for k in z.files}  # block the event loop
+            return self.async_engine.run_locked(
+                lambda: self.engine.load_lora_adapter(name, weights))
+
+        try:
+            slot = await asyncio.get_running_loop().run_in_executor(
+                None, _load_and_install)
+        except RuntimeError as exc:
+            return web.json_response({"error": str(exc)}, status=409)
+        except Exception as exc:
+            return web.json_response(
+                {"error": f"cannot load adapter: {exc}"}, status=400)
+        return web.json_response({"status": "ok", "lora_name": name, "slot": slot})
+
+    async def _unload_lora(self, request: web.Request):
+        if self.engine.lora_registry is None:
+            return web.json_response(
+                {"error": "LoRA serving disabled (EngineConfig.lora unset)"}, status=400)
+        try:
+            body = await request.json()
+            name = body["lora_name"]
+        except Exception:
+            return web.json_response({"error": "lora_name required"}, status=400)
+        try:
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self.async_engine.run_locked(
+                    lambda: self.engine.unload_lora_adapter(name)))
+        except RuntimeError as exc:  # in-flight requests hold the adapter
+            return web.json_response({"error": str(exc)}, status=409)
+        if not ok:
+            return web.json_response({"error": f"unknown adapter {name!r}"}, status=404)
+        return web.json_response({"status": "ok", "lora_name": name})

@@ -1,0 +1,190 @@
+"""LoRA multi-adapter support — batched low-rank deltas on the attention path.
+
+Parity: reference `docs/architecture/core/model-servers.md:55-75` (dynamic LoRA
+serving + metrics contract) and `docs/operations/rollouts/adapter-rollout.md:11-31`
+(runtime adapter updating via `VLLM_ALLOW_RUNTIME_LORA_UPDATING` +
+`lora_filesystem_resolver`; canary via InferenceModelRewrite). TPU-shaped design:
+
+- All adapters live in fixed-shape stacked tensors ``[n_slots, L, ...]`` — loading
+  an adapter writes one slot (one ``.at[slot].set``), so the serving step programs
+  never recompile as adapters come and go.
+- Slot 0 is the permanent null adapter (B = 0 → exact base-model output); every
+  request carries a per-sequence slot index, and a single batched gather applies
+  the right delta per batch row: ``delta = (x @ A[idx]) @ B[idx] * (alpha/r)``.
+- Targets q/k/v/o (the classic attention set). A and B are initialised
+  Kaiming/zero as in the LoRA paper, so a freshly loaded random adapter is a
+  realistic test double; real weights load through the same slot-write path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LoRAConfig:
+    max_adapters: int = 8       # reference vllm:lora_requests_info max_lora
+    rank: int = 8
+    alpha: float = 16.0
+
+    @property
+    def n_slots(self) -> int:
+        return self.max_adapters + 1  # slot 0 = null adapter
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+LORA_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def lora_param_logical_axes(cfg) -> dict[str, tuple]:
+    """Logical axes for the stacked adapter tensors (layers leading so the bank
+    scans with the layer stack; slot dim replicated; output dim of B follows the
+    base weight's tp sharding)."""
+    axes = {}
+    for t in LORA_TARGETS:
+        axes[f"lora_A_{t}"] = ("layers", "lora_slots", "embed", None)
+        out_axis = "embed" if t == "wo" else "heads"
+        axes[f"lora_B_{t}"] = ("layers", "lora_slots", None, out_axis)
+    return axes
+
+
+def init_lora_params(model_cfg, lora_cfg: LoRAConfig) -> dict[str, jax.Array]:
+    """All-zero adapter bank: every slot starts as the null adapter."""
+    L, D = model_cfg.num_layers, model_cfg.hidden_size
+    r, S = lora_cfg.rank, lora_cfg.n_slots
+    dt = model_cfg.jax_dtype
+    dims_out = {
+        "wq": model_cfg.num_heads * model_cfg.head_dim,
+        "wk": model_cfg.num_kv_heads * model_cfg.head_dim,
+        "wv": model_cfg.num_kv_heads * model_cfg.head_dim,
+        "wo": model_cfg.hidden_size,
+    }
+    # wo's input is the concatenated head output, not the hidden dim
+    dims_in = {"wq": D, "wk": D, "wv": D,
+               "wo": model_cfg.num_heads * model_cfg.head_dim}
+    p: dict[str, jax.Array] = {}
+    for t in LORA_TARGETS:
+        p[f"lora_A_{t}"] = jnp.zeros((L, S, dims_in[t], r), dt)
+        p[f"lora_B_{t}"] = jnp.zeros((L, S, r, dims_out[t]), dt)
+    return p
+
+
+def make_adapter_weights(model_cfg, lora_cfg: LoRAConfig, key: jax.Array,
+                         targets: tuple[str, ...] = LORA_TARGETS) -> dict[str, jax.Array]:
+    """One adapter's weights (LoRA init: A ~ Kaiming-ish normal, B = 0 would be a
+    no-op — for test doubles B is also random so the adapter visibly changes
+    outputs; real checkpoints replace both)."""
+    L, D = model_cfg.num_layers, model_cfg.hidden_size
+    r = lora_cfg.rank
+    dt = model_cfg.jax_dtype
+    dims_out = {
+        "wq": model_cfg.num_heads * model_cfg.head_dim,
+        "wk": model_cfg.num_kv_heads * model_cfg.head_dim,
+        "wv": model_cfg.num_kv_heads * model_cfg.head_dim,
+        "wo": model_cfg.hidden_size,
+    }
+    dims_in = {"wq": D, "wk": D, "wv": D,
+               "wo": model_cfg.num_heads * model_cfg.head_dim}
+    out = {}
+    keys = iter(jax.random.split(key, 2 * len(targets)))
+    for t in targets:
+        out[f"lora_A_{t}"] = (
+            jax.random.normal(next(keys), (L, dims_in[t], r), jnp.float32)
+            * (dims_in[t] ** -0.5)
+        ).astype(dt)
+        out[f"lora_B_{t}"] = (
+            jax.random.normal(next(keys), (L, r, dims_out[t]), jnp.float32) * 0.05
+        ).astype(dt)
+    return out
+
+
+def apply_lora(h: jax.Array, A: jax.Array, B: jax.Array, idx: jax.Array,
+               scale: float) -> jax.Array:
+    """Per-row adapter delta. h: [B, T, Din]; A: [S, Din, r]; B: [S, r, Dout];
+    idx: [B] int32 slot per batch row. Returns [B, T, Dout]."""
+    Ab = A[idx]  # [B, Din, r]
+    Bb = B[idx]  # [B, r, Dout]
+    xa = jnp.einsum("btd,bdr->btr", h, Ab)
+    return jnp.einsum("btr,brk->btk", xa, Bb) * scale
+
+
+class LoRARegistry:
+    """Name → slot mapping with ref-counting-free LRU of *inactive* adapters.
+
+    The engine owns the device-side adapter bank; this class owns the naming,
+    slot assignment, and the reference metrics contract fields
+    (`vllm:lora_requests_info{max_lora, running_lora_adapters,
+    waiting_lora_adapters}` — model-servers.md:64-75).
+    """
+
+    def __init__(self, max_adapters: int) -> None:
+        self.max_adapters = max_adapters
+        self.slots: dict[str, int] = {}      # name -> slot (1-based; 0 = null)
+        self._free = list(range(max_adapters, 0, -1))
+        self.running: dict[str, int] = {}    # name -> active request count
+        self.waiting: dict[str, int] = {}
+        self.on_evict = None                 # callback(name) when an idle adapter is displaced
+
+    def slot_of(self, name: Optional[str]) -> int:
+        if name is None:
+            return 0
+        return self.slots.get(name, 0)
+
+    def has(self, name: str) -> bool:
+        return name in self.slots
+
+    def assign(self, name: str) -> int:
+        """Reserve a slot for a new adapter; raises when the bank is full."""
+        if name in self.slots:
+            return self.slots[name]
+        if not self._free:
+            # evict an idle adapter if any (simple policy; the reference offloads)
+            idle = next((n for n in self.slots
+                         if not self.running.get(n) and not self.waiting.get(n)), None)
+            if idle is None:
+                raise RuntimeError(f"all {self.max_adapters} LoRA slots busy")
+            self._free.append(self.slots.pop(idle))
+            if self.on_evict is not None:
+                self.on_evict(idle)
+        slot = self._free.pop()
+        self.slots[name] = slot
+        return slot
+
+    def remove(self, name: str) -> Optional[int]:
+        slot = self.slots.pop(name, None)
+        if slot is not None:
+            self._free.append(slot)
+            self.running.pop(name, None)
+            self.waiting.pop(name, None)
+        return slot
+
+    # request lifecycle hooks (feed the metrics contract)
+    def on_waiting(self, name: Optional[str]) -> None:
+        if name:
+            self.waiting[name] = self.waiting.get(name, 0) + 1
+
+    def on_running(self, name: Optional[str]) -> None:
+        if name:
+            if self.waiting.get(name, 0) > 0:
+                self.waiting[name] -= 1
+            self.running[name] = self.running.get(name, 0) + 1
+
+    def on_finished(self, name: Optional[str]) -> None:
+        if name and self.running.get(name, 0) > 0:
+            self.running[name] -= 1
+
+    def metrics_info(self) -> dict:
+        return {
+            "max_lora": self.max_adapters,
+            "running_lora_adapters": ",".join(
+                sorted(n for n, c in self.running.items() if c > 0)),
+            "waiting_lora_adapters": ",".join(
+                sorted(n for n, c in self.waiting.items() if c > 0)),
+        }

@@ -294,6 +294,8 @@ def forward(
     kv_lens: jax.Array,  # [B] cache length AFTER this step's tokens
     attn_impl=paged_attention,
     moe_matmul_impl=None,
+    lora_indices: Optional[jax.Array] = None,  # [B] adapter slot per row (0 = none)
+    lora_scale: float = 1.0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run tokens through the model, writing K/V into the paged cache.
 
@@ -323,6 +325,13 @@ def forward(
     )
     if "eplb_replica_slots" in params:
         stacked_keys += ("eplb_replica_slots", "eplb_replica_counts")
+    has_lora = "lora_A_wq" in params
+    if has_lora:
+        from llmd_tpu.models.lora import LORA_TARGETS
+
+        stacked_keys += tuple(f"lora_{ab}_{t}" for t in LORA_TARGETS for ab in "AB")
+        if lora_indices is None:
+            lora_indices = jnp.zeros((B,), jnp.int32)
     layer_params = {k: params[k] for k in stacked_keys}
 
     def body(carry, scanned):
@@ -332,12 +341,27 @@ def forward(
         q = jnp.einsum("btd,dhk->bthk", h, lp["wq"])
         k = jnp.einsum("btd,dhk->bthk", h, lp["wk"])
         v = jnp.einsum("btd,dhk->bthk", h, lp["wv"])
+        if has_lora:
+            from llmd_tpu.models.lora import apply_lora
+
+            Hq, Hk, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+            q = q + apply_lora(h, lp["lora_A_wq"], lp["lora_B_wq"], lora_indices,
+                               lora_scale).reshape(B, T, Hq, Dh)
+            k = k + apply_lora(h, lp["lora_A_wk"], lp["lora_B_wk"], lora_indices,
+                               lora_scale).reshape(B, T, Hk, Dh)
+            v = v + apply_lora(h, lp["lora_A_wv"], lp["lora_B_wv"], lora_indices,
+                               lora_scale).reshape(B, T, Hk, Dh)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         cache_l = write_kv(cache_l, k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
                            v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim), flat_slots)
         attn = attn_impl(q, cache_l, page_tables, positions, kv_lens)
-        x = x + jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+        o = jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+        if has_lora:
+            attn_flat = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+            o = o + apply_lora(attn_flat, lp["lora_A_wo"], lp["lora_B_wo"],
+                               lora_indices, lora_scale)
+        x = x + o
 
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)
         if cfg.is_moe:

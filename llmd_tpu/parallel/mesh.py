@@ -77,6 +77,7 @@ DEFAULT_RULES = ShardingRules(rules=(
     ("expert_mlp", "tp"),        # within-expert ffn → tp
     ("kv_pages", None),
     ("layers", None),
+    ("lora_slots", None),        # adapter bank replicated across the mesh
 ))
 
 

@@ -1,0 +1,249 @@
+"""LoRA multi-adapter plane: model-level application, engine lifecycle, dynamic
+load/unload API, metrics contract (reference model-servers.md:55-75,
+adapter-rollout.md:11-31)."""
+
+from __future__ import annotations
+
+import aiohttp
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import run_async
+
+
+def _engine(lora_cfg=None, **over):
+    from llmd_tpu.engine import EngineConfig, LLMEngine
+    from llmd_tpu.models import get_model_config
+
+    base = dict(page_size=8, num_pages=64, max_model_len=128, max_batch_size=4,
+                prefill_chunk=16)
+    base.update(over)
+    return LLMEngine(get_model_config("tiny"), EngineConfig(**base, lora=lora_cfg),
+                     seed=3)
+
+
+# ------------------------------------------------------------------- registry
+
+
+def test_registry_slots_and_eviction():
+    from llmd_tpu.models.lora import LoRARegistry
+
+    reg = LoRARegistry(max_adapters=2)
+    s1, s2 = reg.assign("a"), reg.assign("b")
+    assert {s1, s2} == {1, 2} and reg.assign("a") == s1
+    # full + both idle: assigning a third evicts an idle one
+    s3 = reg.assign("c")
+    assert s3 in (1, 2) and not reg.has("a") or not reg.has("b")
+    # busy adapters are not evictable
+    reg.on_waiting("c")
+    reg.on_running("c")
+    survivors = [n for n in reg.slots if n != "c"]
+    reg.on_waiting(survivors[0])
+    reg.on_running(survivors[0])
+    with pytest.raises(RuntimeError):
+        reg.assign("d")
+    info = reg.metrics_info()
+    assert info["max_lora"] == 2
+    assert "c" in info["running_lora_adapters"]
+
+
+# ------------------------------------------------------------------ model math
+
+
+def test_forward_null_adapter_matches_base():
+    from llmd_tpu.models import get_model_config
+    from llmd_tpu.models.lora import LoRAConfig, init_lora_params
+    from llmd_tpu.models.transformer import forward, init_cache, init_params
+
+    cfg = get_model_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lora = init_lora_params(cfg, LoRAConfig(max_adapters=2, rank=4))
+    cache = init_cache(cfg, 16, 8)
+    toks = jnp.arange(1, 9)[None]
+    pos = jnp.arange(8)[None]
+    pt = jnp.arange(2)[None]
+    lens = jnp.array([8])
+    logits0, _, _ = forward(cfg, params, cache, toks, pos, pt, lens)
+    logits1, _, _ = forward(cfg, {**params, **lora}, cache, toks, pos, pt, lens,
+                            lora_indices=jnp.array([0]))
+    np.testing.assert_allclose(np.asarray(logits0), np.asarray(logits1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_adapter_changes_output_per_row():
+    from llmd_tpu.models import get_model_config
+    from llmd_tpu.models.lora import (LoRAConfig, init_lora_params,
+                                      make_adapter_weights)
+    from llmd_tpu.models.transformer import forward, init_cache, init_params
+
+    cfg = get_model_config("tiny")
+    lcfg = LoRAConfig(max_adapters=2, rank=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    lora = init_lora_params(cfg, lcfg)
+    w = make_adapter_weights(cfg, lcfg, jax.random.PRNGKey(7))
+    lora = {k: v.at[:, 1].set(w[k]) for k, v in lora.items()}
+    cache = init_cache(cfg, 32, 8)
+    B = 2
+    toks = jnp.tile(jnp.arange(1, 9)[None], (B, 1))
+    pos = jnp.tile(jnp.arange(8)[None], (B, 1))
+    pt = jnp.stack([jnp.arange(2), jnp.arange(2, 4)])
+    lens = jnp.array([8, 8])
+    # row 0 uses the null adapter, row 1 uses adapter slot 1 — same tokens
+    logits, _, _ = forward(cfg, {**params, **lora}, cache, toks, pos, pt, lens,
+                           lora_indices=jnp.array([0, 1]))
+    base, adapted = np.asarray(logits[0]), np.asarray(logits[1])
+    assert not np.allclose(base, adapted, atol=1e-3)
+    # and the null row matches a no-lora run exactly
+    logits_plain, _, _ = forward(cfg, params, cache, toks[:1], pos[:1], pt[:1],
+                                 lens[:1])
+    np.testing.assert_allclose(base, np.asarray(logits_plain[0]), rtol=1e-5,
+                               atol=1e-5)
+
+
+# -------------------------------------------------------------------- engine
+
+
+def test_engine_lora_lifecycle_and_divergence():
+    from llmd_tpu.core.request import SamplingParams
+    from llmd_tpu.models.lora import LoRAConfig
+
+    eng = _engine(LoRAConfig(max_adapters=2, rank=4))
+    eng.load_lora_adapter("sql-adapter")
+    prompt = list(range(3, 30))
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
+
+    eng.add_request("base", prompt, sp)
+    eng.add_request("tuned", prompt, sp, lora_id="sql-adapter")
+    done = {"base": [], "tuned": []}
+    while eng.has_work():
+        for out in eng.step():
+            done[out.request_id].extend(out.new_token_ids)
+    assert len(done["base"]) == 6 and len(done["tuned"]) == 6
+    assert done["base"] != done["tuned"]  # adapter visibly changes decode
+
+    # unload frees the slot; requests naming the gone adapter are rejected
+    # (vLLM 404 semantics) instead of silently served by the base model
+    assert eng.unload_lora_adapter("sql-adapter")
+    with pytest.raises(ValueError):
+        eng.add_request("after", prompt, sp, lora_id="sql-adapter")
+
+
+def test_engine_unload_busy_adapter_refused():
+    from llmd_tpu.core.request import SamplingParams
+    from llmd_tpu.models.lora import LoRAConfig
+
+    eng = _engine(LoRAConfig(max_adapters=2, rank=4))
+    eng.load_lora_adapter("busy")
+    eng.add_request("r", list(range(3, 30)), SamplingParams(max_tokens=4), lora_id="busy")
+    with pytest.raises(RuntimeError):
+        eng.unload_lora_adapter("busy")
+    while eng.has_work():
+        eng.step()
+    assert eng.unload_lora_adapter("busy")  # idle again → unload succeeds
+
+
+def test_engine_preemption_keeps_lora_counters_true():
+    from llmd_tpu.models.lora import LoRAConfig, LoRARegistry
+
+    eng = _engine(LoRAConfig(max_adapters=2, rank=4))
+    eng.load_lora_adapter("x")
+    reg: LoRARegistry = eng.lora_registry
+    from llmd_tpu.core.request import SamplingParams
+
+    eng.add_request("r", list(range(3, 20)), SamplingParams(max_tokens=2), lora_id="x")
+    eng.step()  # admit + prefill
+    assert reg.running.get("x") == 1
+    assert eng._preempt_one()
+    assert reg.running.get("x", 0) == 0 and reg.waiting.get("x") == 1
+    while eng.has_work():
+        eng.step()  # re-admit + finish
+    assert reg.running.get("x", 0) == 0 and reg.waiting.get("x", 0) == 0
+
+
+def test_engine_lora_prefix_cache_isolated():
+    """Same prompt, different adapter → different block keys → no cache reuse."""
+    from llmd_tpu.core.request import SamplingParams
+    from llmd_tpu.models.lora import LoRAConfig
+
+    eng = _engine(LoRAConfig(max_adapters=2, rank=4))
+    eng.load_lora_adapter("a1")
+    prompt = list(range(3, 40))
+    sp = SamplingParams(max_tokens=2, temperature=0.0)
+    cached: dict[str, int] = {}
+    for rid, lora in (("r1", None), ("r2", None), ("r3", "a1")):
+        eng.add_request(rid, prompt, sp, lora_id=lora)
+        while eng.has_work():
+            for out in eng.step():
+                cached[out.request_id] = out.num_cached_prompt_tokens
+    assert cached["r2"] > 0        # same adapter (none) → prefix reuse
+    assert cached["r3"] == 0       # different adapter → isolated
+
+
+# -------------------------------------------------------------------- server
+
+
+def test_server_lora_api_and_metrics(tmp_path):
+    from llmd_tpu.engine.config import EngineConfig
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.models import get_model_config
+    from llmd_tpu.models.lora import LoRAConfig
+
+    async def scenario():
+        srv = EngineServer(
+            get_model_config("tiny"),
+            EngineConfig(page_size=8, num_pages=32, max_model_len=64,
+                         max_batch_size=2, prefill_chunk=16,
+                         lora=LoRAConfig(max_adapters=2, rank=4)),
+            model_name="llmd-tpu/tiny", port=0)
+        await srv.start()
+        base = f"http://{srv.address}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/load_lora_adapter",
+                                  json={"lora_name": "my-adapter"}) as r:
+                    assert r.status == 200 and (await r.json())["slot"] == 1
+                # adapters appear in /v1/models
+                async with s.get(f"{base}/v1/models") as r:
+                    ids = [m["id"] for m in (await r.json())["data"]]
+                    assert "my-adapter" in ids
+                # model == adapter name routes to the adapter
+                async with s.post(f"{base}/v1/completions",
+                                  json={"model": "my-adapter", "prompt": "hello",
+                                        "max_tokens": 3, "temperature": 0.0}) as r:
+                    assert r.status == 200
+                    tuned = (await r.json())["choices"][0]["text"]
+                async with s.post(f"{base}/v1/completions",
+                                  json={"model": "llmd-tpu/tiny", "prompt": "hello",
+                                        "max_tokens": 3, "temperature": 0.0}) as r:
+                    base_text = (await r.json())["choices"][0]["text"]
+                assert tuned != base_text
+                async with s.get(f"{base}/metrics") as r:
+                    metrics = await r.text()
+                assert 'vllm:lora_requests_info{max_lora="2"' in metrics
+                async with s.post(f"{base}/v1/unload_lora_adapter",
+                                  json={"lora_name": "my-adapter"}) as r:
+                    assert r.status == 200
+                async with s.post(f"{base}/v1/unload_lora_adapter",
+                                  json={"lora_name": "my-adapter"}) as r:
+                    assert r.status == 404
+                # npz filesystem-resolver path
+                import numpy as _np
+                from llmd_tpu.models.lora import make_adapter_weights
+
+                w = make_adapter_weights(get_model_config("tiny"),
+                                         LoRAConfig(max_adapters=2, rank=4),
+                                         jax.random.PRNGKey(1))
+                path = str(tmp_path / "adapter.npz")
+                # npz has no bfloat16: ship f32, the loader casts to model dtype
+                _np.savez(path, **{k: _np.asarray(v).astype(_np.float32)
+                                   for k, v in w.items()})
+                async with s.post(f"{base}/v1/load_lora_adapter",
+                                  json={"lora_name": "fs-adapter",
+                                        "lora_path": path}) as r:
+                    assert r.status == 200
+        finally:
+            await srv.stop()
+
+    run_async(scenario())
