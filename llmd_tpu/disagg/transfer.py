@@ -385,7 +385,9 @@ def export_from_engine(engine, source: KVTransferSource, request_id: str,
     from llmd_tpu.core.kv_events import block_keys_for_tokens
 
     ps = engine.cfg.page_size
-    keys = block_keys_for_tokens(token_ids, ps, lora_id)
+    # generation-scoped key so exported hashes line up with the engine's own
+    # committed blocks (plain name when LoRA serving is off)
+    keys = block_keys_for_tokens(token_ids, ps, engine._lora_hash_key(lora_id))
     pids: list[int] = []
     hashes: list[int] = []
     chunks: list[list[int]] = []
@@ -415,7 +417,8 @@ def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
     from llmd_tpu.core.kv_events import block_keys_for_tokens
 
     ps = engine.cfg.page_size
-    keys = block_keys_for_tokens(token_ids, ps, lora_id)
+    lora_key = engine._lora_hash_key(lora_id)
+    keys = block_keys_for_tokens(token_ids, ps, lora_key)
     take: list[tuple[int, int]] = []  # (pulled_idx, page_id)
     parent_of: dict[int, Optional[int]] = {}
     parent: Optional[int] = None
@@ -437,6 +440,6 @@ def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
     engine.cache = insert_blocks(engine.cache, pids, pulled.blocks[idxs])
     for i, pid in take:
         h = pulled.block_hashes[i]
-        engine.alloc.commit_block(pid, h, pulled.token_chunks[i], parent_of[h], lora_id)
+        engine.alloc.commit_block(pid, h, pulled.token_chunks[i], parent_of[h], lora_key)
         engine.alloc.release(pid)  # refcount 0 → cached/evictable, like any prefix hit
     return len(take)

@@ -122,8 +122,13 @@ class LLMEngine:
             self.lora_registry = LoRARegistry(engine_cfg.lora.max_adapters)
             # a displaced idle adapter's cached KV is invalid the moment its
             # slot is reassigned
-            self.lora_registry.on_evict = lambda name: self.alloc.purge_lora(name)
+            self.lora_registry.on_evict = lambda name: self._lora_forget(name)
             self._lora_params = init_lora_params(model_cfg, engine_cfg.lora)
+            # name -> content-scoped hash key ("name@<weights-digest>"): KV only
+            # matches KV computed under the SAME weights — stale generations can
+            # never match (HBM, CPU tier, or FS files surviving a restart), while
+            # P/D peers and restarts loading the same checkpoint stay compatible.
+            self._lora_keys: dict[str, str] = {}
             if self.mesh is not None:
                 from llmd_tpu.models.lora import lora_param_logical_axes
                 from llmd_tpu.parallel.mesh import shard_pytree
@@ -335,6 +340,19 @@ class LLMEngine:
             return 0
         return self.lora_registry.slot_of(seq.lora_id)
 
+    def _lora_hash_key(self, name: Optional[str]) -> Optional[str]:
+        """The lora term used in block hashing: generation-scoped when LoRA
+        serving is on, the plain name otherwise (test fixtures etc.)."""
+        if name is None or self.lora_registry is None:
+            return name
+        return self._lora_keys.get(name, name)
+
+    def _lora_forget(self, name: str) -> None:
+        """Retire a name's KV: reclaim HBM pages now; the dropped generation key
+        guarantees tiered copies (CPU/FS) never match again."""
+        self._lora_keys.pop(name, None)
+        self.alloc.purge_lora(name)
+
     def load_lora_adapter(self, name: str, weights: Optional[dict] = None,
                           seed: Optional[int] = None) -> int:
         """Install an adapter into a free slot. ``weights`` maps
@@ -345,15 +363,27 @@ class LLMEngine:
         from llmd_tpu.models.lora import make_adapter_weights
 
         if self.lora_registry.has(name):
-            # re-load under the same name = new weights: KV computed under the
-            # old weights must never prefix-match again (hashes carry only the
-            # adapter NAME, core/kv_events.py)
-            self.alloc.purge_lora(name)
+            if self.lora_registry.running.get(name) or self.lora_registry.waiting.get(name):
+                # same guard as unload: swapping weights under live sequences
+                # would mix two checkpoints in one generation
+                raise RuntimeError(f"adapter {name!r} has in-flight requests")
+            self._lora_forget(name)  # old generation's KV must never match again
         slot = self.lora_registry.assign(name)
+        import hashlib
+
         if weights is None:
+            # deterministic per name (not per process): P/D peers generating the
+            # same test double agree on weights, hence on the content digest
+            name_seed = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "big")
             weights = make_adapter_weights(
                 self.model_cfg, self.cfg.lora,
-                jax.random.PRNGKey(seed if seed is not None else (hash(name) & 0x7FFFFFFF)))
+                jax.random.PRNGKey(seed if seed is not None else name_seed))
+
+        digest = hashlib.sha256()
+        for k in sorted(weights):
+            digest.update(k.encode())
+            digest.update(np.ascontiguousarray(np.asarray(weights[k])).tobytes())
+        self._lora_keys[name] = f"{name}@{digest.hexdigest()[:16]}"
         for key in self._lora_params:  # zero first: partial weight sets must not
             if key not in weights:     # inherit a displaced adapter's leftovers
                 self._lora_params[key] = self._lora_params[key].at[:, slot].set(0)
@@ -376,8 +406,8 @@ class LLMEngine:
             return False
         for key in self._lora_params:  # zero the slot: it is the null adapter again
             self._lora_params[key] = self._lora_params[key].at[:, slot].set(0)
-        # stale-KV defense: blocks computed under this adapter must not be reused
-        self.alloc.purge_lora(name)
+        # reclaim HBM now; the dropped generation key keeps every tier safe
+        self._lora_forget(name)
         return True
 
     def _eplb_record(self, cnt: jax.Array) -> None:
@@ -421,7 +451,7 @@ class LLMEngine:
         seq = Sequence(
             request_id=request_id, token_ids=list(token_ids), prompt_len=len(token_ids),
             max_tokens=sampling.max_tokens, sampling=sampling, lora_id=lora_id,
-            arrival_time=time.monotonic(),
+            lora_key=self._lora_hash_key(lora_id), arrival_time=time.monotonic(),
         )
         self.seqs[request_id] = seq
         self.waiting.append(seq)
@@ -467,7 +497,7 @@ class LLMEngine:
             # prefix-cache lookup over complete prompt blocks
             from llmd_tpu.core.kv_events import block_keys_for_tokens
 
-            keys = block_keys_for_tokens(seq.token_ids[: seq.prompt_len], ps, seq.lora_id)
+            keys = block_keys_for_tokens(seq.token_ids[: seq.prompt_len], ps, seq.lora_key)
             hit_pages = self.alloc.match_prefix(keys) if self.cfg.enable_prefix_caching else []
             # never reuse the whole prompt — the final token's logits must be computed
             max_reuse = max(0, (seq.prompt_len - 1) // ps)
@@ -538,7 +568,7 @@ class LLMEngine:
             bi = n_hbm + i
             chunk = seq.token_ids[bi * ps : (bi + 1) * ps]
             parent = keys[bi - 1] if bi > 0 else None
-            self.alloc.commit_block(pid, keys[bi], chunk, parent, seq.lora_id)
+            self.alloc.commit_block(pid, keys[bi], chunk, parent, seq.lora_key)
         self.stats.total_offload_loads += len(off_pids)
         return off_pids
 

@@ -176,13 +176,16 @@ class PageAllocator:
         return pairs
 
     def purge_lora(self, lora_id: str) -> int:
-        """Invalidate every cached block computed under an adapter (called at
-        unload — the slot's weights are gone, so its KV must never be reused;
-        a same-named adapter loaded later would otherwise serve stale KV)."""
+        """Drop cached blocks computed under an adapter (prompt memory reclaim at
+        unload). Correctness does not depend on this: block hashes carry the
+        generation-scoped lora_key, so stale KV can never match anyway — this
+        just frees the pages early. Matches both bare names and "name@gen" keys."""
         removed: list[int] = []
         for h, pid in list(self.cached.items()):
             info = self.pages.get(pid)
-            if info is None or info.lora_id != lora_id:
+            if info is None or info.lora_id is None or not (
+                info.lora_id == lora_id or info.lora_id.startswith(lora_id + "@")
+            ):
                 continue
             del self.cached[h]
             if h in self.lru:  # evictable → page returns to the free list
@@ -214,6 +217,11 @@ class Sequence:
     max_tokens: int
     sampling: "object" = None  # SamplingParams
     lora_id: Optional[str] = None
+    # generation-scoped hash key (engine._lora_hash_key): "name@<load-ns>" when
+    # LoRA serving is on, == lora_id otherwise. All block hashing uses THIS, so
+    # KV computed under unloaded/reloaded weights can never prefix-match again —
+    # in HBM, the CPU tier, or FS files surviving a restart.
+    lora_key: Optional[str] = None
     pages: list[int] = field(default_factory=list)
     num_computed: int = 0  # tokens whose KV is resident
     num_cached_prompt: int = 0  # tokens reused from prefix cache
@@ -238,7 +246,8 @@ class Sequence:
         while (committed + 1) * ps <= self.num_computed:
             start = committed * ps
             chunk = self.token_ids[start : start + ps]
-            h = hash_block_tokens(self.last_block_hash(), chunk, self.lora_id)
-            alloc.commit_block(self.pages[committed], h, chunk, self.last_block_hash(), self.lora_id)
+            key = self.lora_key if self.lora_key is not None else self.lora_id
+            h = hash_block_tokens(self.last_block_hash(), chunk, key)
+            alloc.commit_block(self.pages[committed], h, chunk, self.last_block_hash(), key)
             self.block_hashes.append(h)
             committed += 1

@@ -87,6 +87,9 @@ class EngineServer:
         self.async_engine = AsyncLLMEngine(self.engine)
         self._runner: Optional[web.AppRunner] = None
         self.request_count = 0
+        from llmd_tpu.obs.tracing import global_tracer
+
+        self.tracer = global_tracer()  # engine hop joins the EPP trace
 
     # -- KV events ---------------------------------------------------------
     def _on_kv_events(self, events: list[KVEvent]) -> None:
@@ -223,12 +226,23 @@ class EngineServer:
         reg = self.engine.lora_registry
         if lora_id is None and reg is not None and reg.has(model):
             lora_id = model
-        if lora_id is not None and reg is not None and not reg.has(lora_id):
+        if lora_id is not None and (reg is None or not reg.has(lora_id)):
+            # vLLM 404 semantics — covers unknown adapters AND LoRA serving being
+            # disabled (silently answering with base weights would mislead the
+            # client and poison the prefix cache under the adapter's name)
             return web.json_response(
                 {"error": {"message": f"unknown LoRA adapter {lora_id!r}"}}, status=404)
 
+        from llmd_tpu.obs.tracing import extract_traceparent
+
+        span = self.tracer.start_span(
+            "engine.generate", parent=extract_traceparent(dict(request.headers)),
+            **{"llm_d.model": model, "llm_d.prompt_tokens": len(token_ids),
+               "llm_d.stream": stream})
+
         ktp = KVTransferParams.from_dict(body.get("kv_transfer_params"))
         if ktp.do_remote_prefill and self.transfer_client is not None:
+            span.add_event("kv_transfer.pull")
             await asyncio.get_running_loop().run_in_executor(
                 None, self._pull_remote_kv, ktp, token_ids, lora_id
             )
@@ -276,6 +290,9 @@ class EngineServer:
                         out_params.remote_host = routable
                     out_params.remote_port = self.transfer_source.port
                     payload["kv_transfer_params"] = out_params.to_dict()
+                span.set_attribute("llm_d.completion_tokens", len(out_ids))
+                span.set_attribute("llm_d.cached_tokens", cached)
+                span.end()
                 return web.json_response(payload)
 
             resp = web.StreamResponse(headers={
@@ -306,9 +323,14 @@ class EngineServer:
                 await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
+            span.set_attribute("llm_d.completion_tokens", n_out)
+            span.end()
             return resp
         except ValueError as e:
+            span.set_error(str(e))
             return web.json_response({"error": {"message": str(e)}}, status=400)
+        finally:
+            span.end()  # idempotent backstop
 
     async def _render(self, request: web.Request):
         try:
@@ -379,6 +401,12 @@ class EngineServer:
             name = body["lora_name"]
         except Exception:
             return web.json_response({"error": "lora_name required"}, status=400)
+        import re
+
+        if not isinstance(name, str) or not re.fullmatch(r"[A-Za-z0-9._/\-]{1,128}", name):
+            # names land in Prometheus label values and hash keys — an unescaped
+            # quote would corrupt the whole /metrics exposition
+            return web.json_response({"error": "invalid lora_name"}, status=400)
         path = body.get("lora_path")
 
         def _load_and_install() -> int:

@@ -1,0 +1,13 @@
+"""Observability plane: tracing + dashboards (SURVEY §5, reference
+docs/operations/observability/)."""
+
+from llmd_tpu.obs.tracing import (
+    Span,
+    TracingConfig,
+    Tracer,
+    extract_traceparent,
+    format_traceparent,
+)
+
+__all__ = ["Span", "Tracer", "TracingConfig", "extract_traceparent",
+           "format_traceparent"]

@@ -151,7 +151,14 @@ class ApproxPrefixCacheProducer(DataProducer):
         if token_ids is None:
             token_ids = [b for b in req.prompt_text().encode("utf-8")]
             req.state[STATE_TOKEN_IDS] = token_ids
-        keys = block_keys_for_tokens(token_ids, self.block_size, req.lora_adapter,
+        # The approx index is router-internal, so its lora term only needs to
+        # ISOLATE traffic classes: `lora_adapter or model` covers the canary flow
+        # where the adapter is addressed as the model name (adapter-rollout.md) —
+        # adapter traffic then builds affinity separately from base traffic.
+        # (The precise producer must instead match engine-computed hashes, which
+        # requires the explicit lora_adapter field.)
+        keys = block_keys_for_tokens(token_ids, self.block_size,
+                                     req.lora_adapter or req.model,
                                      req.mm_hashes)[: self.max_blocks]
         req.state[STATE_BLOCK_KEYS] = keys
         hits: dict[str, int] = {}

@@ -113,6 +113,15 @@ class RouterServer:
             "requests_total": 0, "responses_total": 0, "errors_total": 0,
             "ttft_sum": 0.0, "ttft_count": 0,
         }
+        # e2e latency histogram (promql.md alert HighP99Latency reads the buckets)
+        self._e2e_buckets = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
+        self._e2e_counts = [0] * (len(self._e2e_buckets) + 1)
+        self._e2e_sum = 0.0
+        # OTel-shaped tracing (docs/operations/observability/tracing.md):
+        # proxy/EPP span with child hops propagated via traceparent
+        from llmd_tpu.obs.tracing import global_tracer
+
+        self.tracer = global_tracer()
 
     @property
     def address(self) -> str:
@@ -164,6 +173,14 @@ class RouterServer:
         body["model"] = chosen
         req.state["model_rewritten_to"] = chosen
 
+    def _observe_e2e(self, seconds: float) -> None:
+        self._e2e_sum += seconds
+        for i, b in enumerate(self._e2e_buckets):
+            if seconds <= b:
+                self._e2e_counts[i] += 1
+                return
+        self._e2e_counts[-1] += 1
+
     async def _handle_generate(self, request: web.Request):
         t_start = time.monotonic()
         self.metrics["requests_total"] += 1
@@ -178,10 +195,20 @@ class RouterServer:
             req.priority = self.objectives[req.objective]
         self._rewrite_model(req, body)
 
+        from llmd_tpu.obs.tracing import extract_traceparent
+
+        span = self.tracer.start_span(
+            "epp.request", parent=extract_traceparent(headers),
+            **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
+               "http.route": request.path})
+
         if self.flow:
+            span.add_event("flow_control.enqueue")
             outcome = await self.flow.enqueue_and_wait(req)
             if outcome is not RequestOutcome.DISPATCHED:
                 self.metrics["errors_total"] += 1
+                span.set_error(f"flow control: {outcome.value}")
+                span.end()
                 return web.json_response(
                     {"error": {"message": f"flow control: {outcome.value}"}},
                     status=outcome.http_status,
@@ -189,16 +216,23 @@ class RouterServer:
 
         for p in self._async_producers:
             await p.aproduce(req, self.pool.list(), self._session)
+        span.add_event("schedule.start")
         result = await asyncio.get_running_loop().run_in_executor(
             self._sched_executor, self.scheduler.schedule, req
         )
         if result.endpoint is None:
             self.metrics["errors_total"] += 1
+            span.set_error(f"no endpoint: {result.rejected}")
+            span.end()
             return web.json_response(
                 {"error": {"message": f"no endpoint: {result.rejected}"}}, status=503
             )
+        span.set_attribute("llm_d.endpoint", result.endpoint.address)
+        span.add_event("proxy.forward")
 
-        fwd_headers = {"content-type": "application/json"}
+        fwd_headers = {"content-type": "application/json",
+                       "traceparent": span.traceparent(),
+                       "x-request-id": req.request_id}
         if result.prefill_endpoint is not None:
             fwd_headers[HDR_PREFILLER_HOST_PORT] = result.prefill_endpoint.address
         target = result.endpoint
@@ -211,6 +245,8 @@ class RouterServer:
         except Exception as e:
             self.metrics["errors_total"] += 1
             self.scheduler.post_response(req, target, {"error": str(e)})
+            span.set_error(f"upstream error: {e}")
+            span.end()
             return web.json_response(
                 {"error": {"message": f"upstream error: {e}"}}, status=502
             )
@@ -249,6 +285,12 @@ class RouterServer:
                         info["itl_ms"] = (t_last - t_first) * 1e3 / (n_chunks - 1)
                 self.scheduler.post_response(req, target, info)
                 self.metrics["responses_total"] += 1
+                if "e2e_ms" in info:
+                    self._observe_e2e(info["e2e_ms"] / 1e3)
+                for k in ("ttft_ms", "e2e_ms", "itl_ms"):
+                    if k in info:
+                        span.set_attribute(f"llm_d.{k}", round(info[k], 3))
+                span.end()
                 return out
             payload = await resp.read()
             e2e_s = time.monotonic() - t_start
@@ -264,12 +306,17 @@ class RouterServer:
                 pass
             self.scheduler.post_response(req, target, info)
             self.metrics["responses_total"] += 1
+            self._observe_e2e(e2e_s)
+            span.set_attribute("llm_d.e2e_ms", round(info["e2e_ms"], 3))
+            span.set_attribute("http.status_code", resp.status)
+            span.end()
             return web.Response(
                 body=payload, status=resp.status,
                 headers={"Content-Type": "application/json", **echo},
             )
         finally:
             resp.release()
+            span.end()  # idempotent backstop for exception exits
 
     async def _metrics(self, request: web.Request):
         m = self.metrics
@@ -292,8 +339,21 @@ class RouterServer:
                 f"llm_d_epp_flow_rejected_capacity_total {f['rejected_capacity_total']}",
                 f"llm_d_epp_flow_evicted_ttl_total {f['evicted_ttl_total']}",
             ]
+        lines += [
+            f"llm_d_epp_ttft_seconds_sum {m['ttft_sum']:.6f}",
+            f"llm_d_epp_ttft_seconds_count {m['ttft_count']}",
+        ]
         if m["ttft_count"]:
             lines.append(f"llm_d_epp_ttft_seconds_mean {m['ttft_sum'] / m['ttft_count']:.6f}")
+        cum = 0
+        for b, c in zip(self._e2e_buckets, self._e2e_counts):
+            cum += c
+            lines.append(f'llm_d_epp_e2e_seconds_bucket{{le="{b}"}} {cum}')
+        lines += [
+            f'llm_d_epp_e2e_seconds_bucket{{le="+Inf"}} {cum + self._e2e_counts[-1]}',
+            f"llm_d_epp_e2e_seconds_sum {self._e2e_sum:.6f}",
+            f"llm_d_epp_e2e_seconds_count {cum + self._e2e_counts[-1]}",
+        ]
         for plugin in self.scheduler.plugins.values():
             if hasattr(plugin, "prometheus_lines"):
                 lines += plugin.prometheus_lines()

@@ -35,7 +35,7 @@ def test_registry_slots_and_eviction():
     assert {s1, s2} == {1, 2} and reg.assign("a") == s1
     # full + both idle: assigning a third evicts an idle one
     s3 = reg.assign("c")
-    assert s3 in (1, 2) and not reg.has("a") or not reg.has("b")
+    assert s3 in (1, 2) and (not reg.has("a") or not reg.has("b"))
     # busy adapters are not evictable
     reg.on_waiting("c")
     reg.on_running("c")

@@ -1,0 +1,188 @@
+"""Observability plane: W3C traceparent propagation, parent-based ratio sampling,
+OTLP span shape, and the router→engine trace joining end-to-end (reference
+docs/operations/observability/tracing.md semantics)."""
+
+from __future__ import annotations
+
+import json
+
+import aiohttp
+import pytest
+
+from tests.conftest import run_async
+
+
+def test_traceparent_roundtrip_and_malformed():
+    from llmd_tpu.obs.tracing import SpanContext, extract_traceparent, format_traceparent
+
+    ctx = SpanContext(trace_id="a" * 32, span_id="b" * 16, sampled=True)
+    parsed = extract_traceparent({"Traceparent": format_traceparent(ctx)})
+    assert parsed == ctx
+    assert extract_traceparent({}) is None
+    assert extract_traceparent({"traceparent": "garbage"}) is None
+    assert extract_traceparent({"traceparent": "00-zz-bb-01"}) is None
+    assert extract_traceparent({"traceparent": f"00-{'0'*32}-{'b'*16}-01"}) is None
+
+
+def test_parent_based_sampling():
+    from llmd_tpu.obs.tracing import SpanContext, Tracer, TracingConfig
+
+    t = Tracer(TracingConfig(enabled=True, sample_ratio=0.0, exporter="memory"))
+    # ratio 0: roots never sampled...
+    assert not t.start_span("root").context.sampled
+    # ...but a sampled parent forces the child in (parentbased)
+    parent = SpanContext(trace_id="c" * 32, span_id="d" * 16, sampled=True)
+    assert t.start_span("child", parent=parent).context.sampled
+
+    t2 = Tracer(TracingConfig(enabled=True, sample_ratio=1.0, exporter="memory"))
+    with t2.start_span("always") as span:
+        span.set_attribute("k", "v")
+    assert len(t2.spans) == 1
+
+    # deterministic ratio: ~half of roots sampled at 0.5
+    t3 = Tracer(TracingConfig(enabled=True, sample_ratio=0.5, exporter="memory"))
+    n = sum(t3.start_span(f"s{i}").context.sampled for i in range(400))
+    assert 120 < n < 280
+
+
+def test_span_otlp_shape_and_error_status():
+    from llmd_tpu.obs.tracing import Tracer, TracingConfig
+
+    t = Tracer(TracingConfig(enabled=True, sample_ratio=1.0, exporter="memory"))
+    with pytest.raises(ValueError):
+        with t.start_span("op", **{"llm_d.model": "m"}) as span:
+            span.add_event("step", detail="x")
+            raise ValueError("boom")
+    otlp = t.spans[0].to_otlp()
+    assert otlp["name"] == "op" and otlp["status"]["code"] == 2
+    assert any(a["key"] == "error.message" for a in otlp["attributes"])
+    assert otlp["events"][0]["name"] == "step"
+    assert len(otlp["traceId"]) == 32 and len(otlp["spanId"]) == 16
+
+
+def test_jsonl_exporter(tmp_path):
+    from llmd_tpu.obs.tracing import Tracer, TracingConfig
+
+    path = str(tmp_path / "traces.jsonl")
+    t = Tracer(TracingConfig(enabled=True, sample_ratio=1.0, exporter="jsonl",
+                             jsonl_path=path))
+    with t.start_span("a"):
+        pass
+    with t.start_span("b"):
+        pass
+    t.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert [l["name"] for l in lines] == ["a", "b"]
+
+
+def test_router_engine_trace_joins_end_to_end():
+    """One trace: client traceparent → epp.request → engine.generate."""
+
+    CFG = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+"""
+
+    async def scenario():
+        from llmd_tpu.core.config import FrameworkConfig
+        from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+        from llmd_tpu.engine.config import EngineConfig
+        from llmd_tpu.engine.server import EngineServer
+        from llmd_tpu.models import get_model_config
+        from llmd_tpu.obs.tracing import SpanContext, Tracer, TracingConfig, format_traceparent
+        from llmd_tpu.router import filters_pickers as _fp, scorers as _s  # noqa
+        from llmd_tpu.router.plugins import known_plugin_types
+        from llmd_tpu.router.server import RouterServer
+
+        tracer = Tracer(TracingConfig(enabled=True, sample_ratio=1.0, exporter="memory"))
+        eng_srv = EngineServer(
+            get_model_config("tiny"),
+            EngineConfig(page_size=8, num_pages=32, max_model_len=64,
+                         max_batch_size=2, prefill_chunk=16),
+            model_name="llmd-tpu/tiny", port=0)
+        eng_srv.tracer = tracer
+        await eng_srv.start()
+        pool = EndpointPool()
+        pool.upsert(Endpoint(address=eng_srv.address))
+        router = RouterServer(
+            FrameworkConfig.from_yaml(CFG, known_types=known_plugin_types()),
+            pool, port=0, poll_interval_s=0.2)
+        router.tracer = tracer
+        await router.start()
+        try:
+            client_ctx = SpanContext(trace_id="e" * 32, span_id="f" * 16, sampled=True)
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://{router.address}/v1/completions",
+                    json={"model": "llmd-tpu/tiny", "prompt": "trace me",
+                          "max_tokens": 3, "temperature": 0.0},
+                    headers={"traceparent": format_traceparent(client_ctx)},
+                ) as resp:
+                    assert resp.status == 200
+            names = {sp.name: sp for sp in tracer.spans}
+            assert {"epp.request", "engine.generate"} <= set(names)
+            epp, eng = names["epp.request"], names["engine.generate"]
+            # all three hops share the client's trace id; parentage chains
+            assert epp.context.trace_id == "e" * 32
+            assert eng.context.trace_id == "e" * 32
+            assert epp.parent_span_id == "f" * 16
+            assert eng.parent_span_id == epp.context.span_id
+            assert epp.attributes["llm_d.endpoint"] == eng_srv.address
+            assert int(eng.attributes["llm_d.completion_tokens"]) == 3
+        finally:
+            await router.stop()
+            await eng_srv.stop()
+
+    run_async(scenario())
+
+
+def test_router_metrics_expose_histogram_and_lora_alerting_surface():
+    """The promql.md queries must find their series: ttft sum/count + e2e buckets."""
+
+    CFG = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+"""
+
+    async def scenario():
+        from llmd_tpu.core.config import FrameworkConfig
+        from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+        from llmd_tpu.router import filters_pickers as _fp, scorers as _s  # noqa
+        from llmd_tpu.router.plugins import known_plugin_types
+        from llmd_tpu.router.server import RouterServer
+        from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+
+        backend = FakeModelServer(FakeServerConfig())
+        await backend.start()
+        pool = EndpointPool()
+        pool.upsert(Endpoint(address=backend.address))
+        router = RouterServer(
+            FrameworkConfig.from_yaml(CFG, known_types=known_plugin_types()),
+            pool, port=0, poll_interval_s=0.2)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://{router.address}/v1/completions",
+                    json={"model": "fake/model", "prompt": "hi", "max_tokens": 2},
+                ) as resp:
+                    assert resp.status == 200
+                async with s.get(f"http://{router.address}/metrics") as resp:
+                    text = await resp.text()
+            assert "llm_d_epp_ttft_seconds_sum" in text
+            assert "llm_d_epp_ttft_seconds_count 1" in text
+            assert 'llm_d_epp_e2e_seconds_bucket{le="+Inf"} 1' in text
+            assert "llm_d_epp_e2e_seconds_count 1" in text
+        finally:
+            await router.stop()
+            await backend.stop()
+
+    run_async(scenario())
