@@ -24,13 +24,61 @@ HDR_SLO_TPOT_MS = "x-llm-d-slo-tpot-ms"
 HDR_PREFILLER_HOST_PORT = "x-prefiller-host-port"
 
 
+def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
+    """Content hash of one multimodal message part (image_url / input_audio...).
+
+    The reference folds these into KV block keys (kv-indexer.md:14,146-151) so two
+    prompts with different images never share cache entries."""
+    import hashlib
+
+    if part.get("type") == "image_url":
+        url = (part.get("image_url") or {}).get("url", "")
+        return hashlib.sha256(url.encode()).digest() if url else None
+    if part.get("type") == "input_audio":
+        data = (part.get("input_audio") or {}).get("data", "")
+        return hashlib.sha256(data.encode()).digest() if data else None
+    return None
+
+
 def flatten_messages(messages: Sequence[dict[str, Any]]) -> str:
     """Canonical chat→text flattening shared by router, engine, and test fixture.
 
     Router-side block keys are computed over this rendering, so every component MUST use
     this one helper (divergence silently breaks prefix-cache scoring).
+
+    Multimodal content parts render as ``<image:hash16>`` placeholders — the media
+    identity lands IN the token stream at its position, so engine-side block hashes
+    (computed over tokens) distinguish different images without extra plumbing,
+    mirroring the reference's mm-extra-keys fold (kv-indexer.md:146-151).
     """
-    return "\n".join(f"{m.get('role', '')}: {m.get('content', '')}" for m in messages)
+    out = []
+    for m in messages:
+        content = m.get("content", "")
+        if isinstance(content, list):
+            pieces = []
+            for part in content:
+                if part.get("type") == "text":
+                    pieces.append(part.get("text", ""))
+                else:
+                    h = _mm_hash(part)
+                    kind = part.get("type", "media")
+                    pieces.append(f"<{kind}:{h.hex()[:16]}>" if h else f"<{kind}>")
+            content = " ".join(pieces)
+        out.append(f"{m.get('role', '')}: {content}")
+    return "\n".join(out)
+
+
+def mm_hashes_from_messages(messages: Sequence[dict[str, Any]]) -> list[bytes]:
+    """All multimodal content hashes in order of appearance."""
+    hashes: list[bytes] = []
+    for m in messages:
+        content = m.get("content")
+        if isinstance(content, list):
+            for part in content:
+                h = _mm_hash(part)
+                if h is not None:
+                    hashes.append(h)
+    return hashes
 
 
 @dataclass

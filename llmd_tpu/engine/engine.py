@@ -204,10 +204,25 @@ class LLMEngine:
             )
             return toks_out, cache, cnts.sum(0)  # [k, B], cache, [L, E]
 
+        def _embed(params, cache, tokens, positions, page_table, kv_len, lora_idx):
+            """Prefill chunk returning the sum of valid positions' final hidden
+            states — the pooling accumulator for /v1/embeddings."""
+            tokens = _bind(tokens, "sp")
+            positions = _bind(positions, "sp")
+            _logits, cache, _cnt, hidden = forward(
+                cfg, params, cache, tokens[None], positions[None], page_table[None],
+                kv_len[None], attn_impl=attn, moe_matmul_impl=moe_impl,
+                lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
+                with_hidden=True,
+            )
+            valid = (positions >= 0).astype(jnp.float32)[None, :, None]
+            return jnp.sum(hidden.astype(jnp.float32) * valid, axis=(0, 1)), cache
+
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
         self._prefill_fn = jax.jit(_prefill, **donate)
         self._decode_fn = jax.jit(_decode, **donate)
         self._decode_multi_fn = jax.jit(_decode_multi, **donate)
+        self._embed_fn = jax.jit(_embed, **donate)
 
     def _select_attn_impl(self):
         """Pick the attention kernel: Pallas on TPU (after a smoke compile),
@@ -840,6 +855,54 @@ class LLMEngine:
         if len(seq.token_ids) >= self.cfg.max_model_len:
             return True, "length"
         return False, None
+
+    # ------------------------------------------------------------- embeddings
+    def embed(self, token_ids: list[int], lora_id: Optional[str] = None) -> list[float]:
+        """Mean-pooled, L2-normalised final hidden state (/v1/embeddings path).
+
+        Runs chunk-wise through the same compiled prefill program family (one
+        extra jit), borrowing KV pages only for the duration of the call. The
+        caller serialises against the step loop (run_locked in the server).
+        """
+        if not token_ids:
+            raise ValueError("empty input")
+        token_ids = token_ids[: self.cfg.max_model_len - 1]
+        chunk = self.cfg.prefill_chunk
+        ps = self.cfg.page_size
+        need = (len(token_ids) + ps - 1) // ps
+        pages: list[int] = []
+        for _ in range(need):
+            pid = self.alloc.allocate()
+            if pid is None:
+                for p in pages:
+                    self.alloc.release(p)
+                raise RuntimeError("no free KV pages for embedding request")
+            pages.append(pid)
+        try:
+            pt = np.full((self.cfg.max_pages_per_seq,), -1, np.int32)
+            pt[: len(pages)] = pages
+            lora_idx = jnp.asarray(
+                [self.lora_registry.slot_of(lora_id) if self.lora_registry else 0],
+                jnp.int32)
+            acc = np.zeros((self.model_cfg.hidden_size,), np.float64)
+            for start in range(0, len(token_ids), chunk):
+                n = min(chunk, len(token_ids) - start)
+                toks = np.zeros((chunk,), np.int32)
+                toks[:n] = token_ids[start : start + n]
+                pos = np.full((chunk,), -1, np.int32)
+                pos[:n] = np.arange(start, start + n)
+                h_sum, self.cache = self._embed_fn(
+                    self._run_params(), self.cache, jnp.asarray(toks),
+                    jnp.asarray(pos), jnp.asarray(pt),
+                    jnp.asarray(start + n, jnp.int32), lora_idx,
+                )
+                acc += np.asarray(h_sum, np.float64)
+        finally:
+            for p in pages:
+                self.alloc.release(p)
+        vec = acc / max(1, len(token_ids))
+        norm = float(np.linalg.norm(vec))
+        return (vec / norm if norm > 0 else vec).astype(float).tolist()
 
     # ------------------------------------------------------------- convenience
     def generate(self, prompts: list[list[int]], sampling: Optional[SamplingParams] = None) -> dict[str, list[int]]:

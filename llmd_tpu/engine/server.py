@@ -140,6 +140,7 @@ class EngineServer:
         app.router.add_get("/v1/models", self._models)
         app.router.add_post("/v1/load_lora_adapter", self._load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self._unload_lora)
+        app.router.add_post("/v1/embeddings", self._embeddings)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -331,6 +332,51 @@ class EngineServer:
             return web.json_response({"error": {"message": str(e)}}, status=400)
         finally:
             span.end()  # idempotent backstop
+
+    async def _embeddings(self, request: web.Request):
+        """OpenAI /v1/embeddings: mean-pooled L2-normalised final hidden states
+        (openai-parser endpoint list, request-handling.md:50-73)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        inp = body.get("input")
+        if inp is None:
+            return web.json_response({"error": {"message": "input required"}}, status=400)
+        items = [inp] if isinstance(inp, (str,)) else list(inp)
+        if items and isinstance(items[0], int):  # single pre-tokenized prompt
+            items = [items]
+        model = body.get("model", self.model_name)
+        lora_id = body.get("lora_adapter")
+        reg = self.engine.lora_registry
+        if lora_id is None and reg is not None and reg.has(model):
+            lora_id = model
+        if lora_id is not None and (reg is None or not reg.has(lora_id)):
+            return web.json_response(
+                {"error": {"message": f"unknown LoRA adapter {lora_id!r}"}}, status=404)
+
+        loop = asyncio.get_running_loop()
+        data = []
+        total_tokens = 0
+        for i, item in enumerate(items):
+            ids = item if isinstance(item, list) else self.tokenizer.encode(str(item))
+            if not ids:
+                return web.json_response(
+                    {"error": {"message": f"empty input at index {i}"}}, status=400)
+            total_tokens += len(ids)
+            try:
+                vec = await loop.run_in_executor(
+                    None,
+                    lambda ids=ids: self.async_engine.run_locked(
+                        lambda: self.engine.embed(ids, lora_id)))
+            except RuntimeError as exc:
+                return web.json_response({"error": {"message": str(exc)}}, status=503)
+            data.append({"object": "embedding", "index": i, "embedding": vec})
+        self.request_count += 1
+        return web.json_response({
+            "object": "list", "model": model, "data": data,
+            "usage": {"prompt_tokens": total_tokens, "total_tokens": total_tokens},
+        })
 
     async def _render(self, request: web.Request):
         try:

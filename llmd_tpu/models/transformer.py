@@ -296,7 +296,8 @@ def forward(
     moe_matmul_impl=None,
     lora_indices: Optional[jax.Array] = None,  # [B] adapter slot per row (0 = none)
     lora_scale: float = 1.0,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    with_hidden: bool = False,  # append final-norm hidden states (embeddings path)
+) -> tuple[jax.Array, ...]:
     """Run tokens through the model, writing K/V into the paged cache.
 
     Serves both chunked prefill (T = chunk) and decode (T = 1): the engine packs
@@ -388,6 +389,8 @@ def forward(
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
     unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), unembed.astype(jnp.float32))
+    if with_hidden:
+        return logits, new_cache, expert_counts, x
     return logits, new_cache, expert_counts
 
 

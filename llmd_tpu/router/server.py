@@ -35,7 +35,7 @@ from llmd_tpu.router.flowcontrol import FlowController
 from llmd_tpu.router.scheduler import Scheduler
 from llmd_tpu.router.scorers import STATE_TOKEN_IDS
 
-GEN_PATHS = ("/v1/completions", "/v1/chat/completions")
+GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/embeddings")
 
 
 def parse_openai_request(path: str, body: dict, headers: dict[str, str]) -> InferenceRequest:
@@ -44,8 +44,15 @@ def parse_openai_request(path: str, body: dict, headers: dict[str, str]) -> Infe
     req.model = str(body.get("model", ""))
     if "messages" in body:
         req.messages = body["messages"]
+        from llmd_tpu.core.request import mm_hashes_from_messages
+
+        req.mm_hashes = mm_hashes_from_messages(body["messages"])
+    elif "input" in body:  # /v1/embeddings: input is str | [str] | [int] | [[int]]
+        inp = body["input"]
+        req.prompt = inp if isinstance(inp, str) else json.dumps(inp)
     else:
         req.prompt = str(body.get("prompt", ""))
+    req.lora_adapter = body.get("lora_adapter")
     req.sampling = SamplingParams(
         max_tokens=int(body.get("max_tokens", 16)),
         temperature=float(body.get("temperature", 1.0)),

@@ -78,6 +78,7 @@ class FakeModelServer:
         app = web.Application()
         app.router.add_post("/v1/completions", self._completions)
         app.router.add_post("/v1/chat/completions", self._chat)
+        app.router.add_post("/v1/embeddings", self._embeddings)
         app.router.add_post("/v1/completions/render", self._render)
         app.router.add_post("/v1/chat/completions/render", self._render)
         app.router.add_get("/metrics", self._metrics)
@@ -224,6 +225,25 @@ class FakeModelServer:
         body = await request.json()
         prompt = flatten_messages(body.get("messages", []))
         return await self._serve_generation(request, prompt, body, chat=True)
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        import hashlib
+
+        body = await request.json()
+        inp = body.get("input", "")
+        items = [inp] if isinstance(inp, str) else list(inp)
+        self.request_count += 1
+        data = []
+        for i, item in enumerate(items):
+            # deterministic pseudo-embedding from the content hash
+            h = hashlib.sha256(str(item).encode()).digest()
+            vec = [((b / 255.0) * 2 - 1) for b in h[:16]]
+            data.append({"object": "embedding", "index": i, "embedding": vec})
+        ntok = sum(len(fake_tokenize(str(it))) for it in items)
+        return web.json_response({
+            "object": "list", "model": body.get("model", self.cfg.model), "data": data,
+            "usage": {"prompt_tokens": ntok, "total_tokens": ntok},
+        })
 
     async def _render(self, request: web.Request) -> web.Response:
         body = await request.json()
