@@ -1,16 +1,20 @@
 """Serving throughput benchmark on the local chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...provenance}.
 
 Measures steady-state output token throughput (the reference's headline unit — output
 tok/s, e.g. BASELINE.md rows 5/7/13) of the flagship single-chip model (llama-1b,
 random weights) under continuous batching: 32 concurrent requests, ISL 256 / OSL 128,
-greedy, multi-step fused decode.
+greedy, batched-across-sequences chunked prefill + multi-step fused decode.
 
 vs_baseline anchors to BASELINE.md row 5: ~3,100 output tok/s per decode GPU
 (16x16 B200 wide-EP) — the reference's per-accelerator decode throughput headline.
 A v5e chip has ~1/20 the FLOPs/HBM-BW of a B200, so >0.1 here already means the
 serving stack itself (batching, paging, fused decode) is not the bottleneck.
+
+Kernel provenance (VERDICT r1 'What's weak' #2): the JSON records which attention /
+MoE implementation actually served the run and why any fallback fired, plus achieved
+model-bandwidth and MFU estimates, so the number is diagnosable.
 
 Usage: python bench.py [--tiny] [--cpu]   (flags for CI-sized smoke runs)
 """
@@ -20,6 +24,29 @@ from __future__ import annotations
 import json
 import sys
 import time
+
+
+def _param_count(cfg) -> int:
+    D, L, F = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
+    H, Hk, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    per_layer = D * (H + 2 * Hk) * Dh + H * Dh * D + 3 * D * F  # qkvo + swiglu
+    emb = cfg.vocab_size * D * (1 if cfg.tie_embeddings else 2)
+    return per_layer * L + emb
+
+
+def _chip_peaks(device_kind: str) -> tuple[float, float]:
+    """(bf16 TFLOP/s, HBM GB/s) for MFU / bandwidth-utilization estimates."""
+    kinds = {
+        "TPU v5 lite": (197.0, 819.0),
+        "TPU v5e": (197.0, 819.0),
+        "TPU v5p": (459.0, 2765.0),
+        "TPU v4": (275.0, 1228.0),
+        "TPU v6e": (918.0, 1640.0),
+    }
+    for k, v in kinds.items():
+        if k.lower() in device_kind.lower():
+            return v
+    return (197.0, 819.0)  # default to v5e-class
 
 
 def main() -> None:
@@ -43,16 +70,23 @@ def main() -> None:
     if tiny:
         model, n_req, isl, osl = "tiny", 8, 64, 32
         eng_cfg = EngineConfig(page_size=16, num_pages=256, max_model_len=512,
-                               max_batch_size=8, prefill_chunk=64, decode_steps=8)
+                               max_batch_size=8, prefill_chunk=64, decode_steps=8,
+                               max_num_batched_tokens=256)
     else:
         model, n_req, isl, osl = "llama-1b", 32, 256, 128
         eng_cfg = EngineConfig(page_size=16, num_pages=2048, max_model_len=1024,
-                               max_batch_size=32, prefill_chunk=256, decode_steps=16)
+                               max_batch_size=32, prefill_chunk=256, decode_steps=16,
+                               max_num_batched_tokens=2048)
 
     cfg = get_model_config(model)
     t0 = time.monotonic()
     eng = LLMEngine(cfg, eng_cfg)
-    print(f"# engine built in {time.monotonic() - t0:.1f}s on {jax.devices()[0]}", file=sys.stderr)
+    dev = jax.devices()[0]
+    print(f"# engine built in {time.monotonic() - t0:.1f}s on {dev}", file=sys.stderr)
+    print(f"# attn_backend={eng.attn_backend}"
+          + (f" (fallback: {eng.attn_fallback_reason})" if eng.attn_fallback_reason else ""),
+          file=sys.stderr)
+    print(f"# moe_backend={eng.moe_backend}", file=sys.stderr)
 
     sp = SamplingParams(max_tokens=osl, temperature=0.0, ignore_eos=True)
 
@@ -61,7 +95,7 @@ def main() -> None:
         return [[(salt * 7919 + i * 131 + j) % (cfg.vocab_size - 2) + 1 for j in range(isl)]
                 for i in range(n)]
 
-    # Warmup: compile prefill + fused decode (and exercise the allocator)
+    # Warmup: compile unified prefill + fused decode (and exercise the allocator)
     t0 = time.monotonic()
     eng.generate(prompts(2, salt=1), SamplingParams(max_tokens=osl, temperature=0.0, ignore_eos=True))
     print(f"# warmup/compile {time.monotonic() - t0:.1f}s", file=sys.stderr)
@@ -72,16 +106,42 @@ def main() -> None:
     out_tokens = sum(len(v) for v in out.values())
     assert out_tokens == n_req * osl, (out_tokens, n_req * osl)
     tput = out_tokens / wall
+
+    # --- provenance / roofline context -------------------------------------
+    n_params = _param_count(cfg)
+    bytes_per_param = 2 if cfg.dtype == "bfloat16" else 4
+    peak_tflops, peak_gbs = _chip_peaks(getattr(dev, "device_kind", ""))
+    # decode reads all weights once per step for max_batch_size tokens
+    model_gb = n_params * bytes_per_param / 1e9
+    hbm_gb_per_tok = model_gb / max(1, eng_cfg.max_batch_size)
+    achieved_gbs = tput * hbm_gb_per_tok  # weights-traffic-only lower bound
+    flops_per_tok = 2 * n_params
+    mfu = tput * flops_per_tok / (peak_tflops * 1e12)
+
     print(f"# {out_tokens} output tokens in {wall:.2f}s "
           f"(prefill {eng.stats.total_prefill_tokens} toks, "
           f"decode {eng.stats.total_decode_tokens} toks, "
           f"preemptions {eng.stats.total_preemptions})", file=sys.stderr)
+    print(f"# model {n_params/1e9:.2f}B params ({model_gb:.2f} GB bf16); "
+          f"weights-BW {achieved_gbs:.0f} GB/s of ~{peak_gbs:.0f} peak "
+          f"({achieved_gbs/peak_gbs*100:.0f}%); decode-MFU {mfu*100:.2f}%",
+          file=sys.stderr)
 
     print(json.dumps({
         "metric": "output_tok_per_s_per_chip",
         "value": round(tput, 1),
         "unit": "tok/s",
         "vs_baseline": round(tput / 3100.0, 4),
+        "attn_backend": eng.attn_backend,
+        "attn_fallback_reason": eng.attn_fallback_reason,
+        "moe_backend": eng.moe_backend,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "weights_bw_gbs": round(achieved_gbs, 1),
+        "weights_bw_util": round(achieved_gbs / peak_gbs, 3),
+        "decode_mfu": round(mfu, 4),
+        "prefill_tokens": eng.stats.total_prefill_tokens,
+        "decode_tokens": eng.stats.total_decode_tokens,
+        "preemptions": eng.stats.total_preemptions,
     }))
 
 

@@ -45,26 +45,35 @@ MAGIC = b"KVT1"
 # ---------------------------------------------------------------------------
 
 
-def extract_blocks(cache, page_ids: list[int]) -> np.ndarray:
-    """Gather pages from the device cache into one contiguous host buffer.
+def extract_blocks(cache, page_ids: list[int], pages_per_layer: Optional[int] = None) -> np.ndarray:
+    """Gather logical pages from the device cache into one contiguous host buffer.
 
-    cache: [L, 2, P, ps, Hk, Dh] → returns [n, L, 2, ps, Hk, Dh] (block-major so each
-    block is a contiguous byte range — streamable/sliceable without repacking).
+    cache: flat layer-folded pool [L*P, ps, 2Hk, Dhp] (P = pages_per_layer; None =
+    single-layer pool) → returns [n, L, ps, 2Hk, Dhp] (block-major so each block is
+    a contiguous byte range — streamable/sliceable without repacking).
     """
     import jax
     import jax.numpy as jnp
 
-    sub = cache[:, :, jnp.asarray(np.asarray(page_ids, np.int32))]
-    arr = np.asarray(jax.device_get(sub))  # [L, 2, n, ps, Hk, Dh]
-    return np.ascontiguousarray(np.moveaxis(arr, 2, 0))
+    P = pages_per_layer or cache.shape[0]
+    L = cache.shape[0] // P
+    pids = np.asarray(page_ids, np.int32)
+    rows = np.arange(L)[:, None] * P + pids[None, :]  # [L, n]
+    arr = np.asarray(jax.device_get(cache[jnp.asarray(rows)]))  # [L, n, ps, 2Hk, Dhp]
+    return np.ascontiguousarray(np.moveaxis(arr, 1, 0))
 
 
-def insert_blocks(cache, page_ids: list[int], blocks: np.ndarray):
-    """Write pulled blocks ([n, L, 2, ps, Hk, Dh]) into device pages; returns new cache."""
+def insert_blocks(cache, page_ids: list[int], blocks: np.ndarray,
+                  pages_per_layer: Optional[int] = None):
+    """Write pulled blocks ([n, L, ps, 2Hk, Dhp]) into device pages; returns new cache."""
     import jax.numpy as jnp
 
-    dev = jnp.asarray(np.moveaxis(blocks, 0, 2)).astype(cache.dtype)
-    return cache.at[:, :, jnp.asarray(np.asarray(page_ids, np.int32))].set(dev)
+    P = pages_per_layer or cache.shape[0]
+    L = cache.shape[0] // P
+    pids = np.asarray(page_ids, np.int32)
+    rows = np.arange(L)[:, None] * P + pids[None, :]  # [L, n]
+    dev = jnp.asarray(np.moveaxis(blocks, 0, 1)).astype(cache.dtype)
+    return cache.at[jnp.asarray(rows)].set(dev)
 
 
 # ---------------------------------------------------------------------------
@@ -110,7 +119,7 @@ class ExportedKV:
     token_chunks: list[list[int]]
     payload: bytes  # contiguous staging buffer (n blocks back-to-back)
     dtype: str
-    block_shape: tuple[int, ...]  # [L, 2, ps, Hk, Dh]
+    block_shape: tuple[int, ...]  # [L, ps, 2Hk, Dhp]
     created: float = field(default_factory=time.monotonic)
 
 
@@ -338,7 +347,7 @@ class KVTransferSource:
 class PulledKV:
     block_hashes: list[int]
     token_chunks: list[list[int]]
-    blocks: np.ndarray  # [n, L, 2, ps, Hk, Dh]
+    blocks: np.ndarray  # [n, L, ps, 2Hk, Dhp]
 
 
 class KVTransferClient:
@@ -399,7 +408,7 @@ def export_from_engine(engine, source: KVTransferSource, request_id: str,
         hashes.append(h)
         chunks.append(token_ids[i * ps : (i + 1) * ps])
     if pids:
-        blocks = extract_blocks(engine.cache, pids)
+        blocks = extract_blocks(engine.cache, pids, engine.cfg.num_pages)
         source.register(request_id, hashes, chunks, blocks)
     return KVTransferParams(
         remote_request_id=request_id, num_blocks=len(pids),
@@ -437,7 +446,7 @@ def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
         return 0
     idxs = [i for i, _ in take]
     pids = [p for _, p in take]
-    engine.cache = insert_blocks(engine.cache, pids, pulled.blocks[idxs])
+    engine.cache = insert_blocks(engine.cache, pids, pulled.blocks[idxs], engine.cfg.num_pages)
     for i, pid in take:
         h = pulled.block_hashes[i]
         engine.alloc.commit_block(pid, h, pulled.token_chunks[i], parent_of[h], lora_key)

@@ -18,7 +18,11 @@ class EngineConfig:
     max_model_len: int = 2048
     # Continuous batching
     max_batch_size: int = 8  # decode slots
-    prefill_chunk: int = 128  # chunked-prefill token budget per step
+    prefill_chunk: int = 128  # per-sequence chunked-prefill cap per step
+    # Flat token budget of the unified step (--max-num-batched-tokens): decode
+    # tokens + prefill chunks from MULTIPLE sequences pack into one program call.
+    # None = max(prefill_chunk, max_batch_size) (one chunk + a decode batch).
+    max_num_batched_tokens: "int | None" = None
     enable_prefix_caching: bool = True
     # Parallelism
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -39,9 +43,9 @@ class EngineConfig:
     offload_fs_path: "str | None" = None
     # P/D role (disaggregation/README.md roles kv_producer/kv_consumer/both)
     role: str = "both"
-    # Attention kernel: "auto" = Pallas on TPU / reference semantics elsewhere,
-    # "pallas" = force the Pallas kernel (interpret mode off-TPU), "reference" =
-    # gather+mask semantics (models.transformer.paged_attention).
+    # Attention kernel: "auto" = Pallas ragged-paged-attention on TPU / XLA
+    # reference semantics elsewhere, "pallas" = force the Pallas kernel,
+    # "reference" = gather+mask (models.transformer.ragged_paged_attention_xla).
     attn_impl: str = "auto"
     # MoE expert GEMMs: "auto" = Pallas grouped GEMM on TPU / einsum elsewhere,
     # "pallas" = force (interpret off-TPU), "einsum" = XLA dot path.
@@ -56,3 +60,9 @@ class EngineConfig:
     @property
     def max_pages_per_seq(self) -> int:
         return (self.max_model_len + self.page_size - 1) // self.page_size
+
+    @property
+    def batched_tokens(self) -> int:
+        if self.max_num_batched_tokens is not None:
+            return max(self.max_num_batched_tokens, self.max_batch_size)
+        return max(self.prefill_chunk, self.max_batch_size)

@@ -3,13 +3,20 @@
 Mirrors the serving loop the reference drives through vLLM (SURVEY.md §3.1 'HOT LOOP:
 continuous batching on accelerator'), built XLA-first:
 
-- exactly two compiled programs after warmup — ``_prefill_fn`` (B=1, fixed chunk) and
-  ``_decode_fn`` (fixed slot batch, 1 token/slot) — both static-shaped; the host
-  scheduler packs work into them,
-- chunked prefill (agentic-serving's --max-num-batched-tokens analogue) so long prompts
-  never starve decode,
+- exactly two compiled programs after warmup — ``_unified_fn`` (flat mixed batch:
+  several sequences' prefill chunks + decode tokens packed into a fixed
+  ``max_num_batched_tokens`` budget, the --max-num-batched-tokens analogue) and
+  ``_decode_multi_fn`` (fixed slot batch, k fused decode iterations under
+  ``lax.scan``) — both static-shaped; the host scheduler packs work into them,
+- prefill batches ACROSS sequences: 32 arriving requests chunk-prefill together up
+  to the token budget instead of one sequence per step,
+- prefill never pays the [N, vocab] logits matmul — only each sequence's last
+  hidden row is unembedded,
 - automatic prefix caching with chained block hashes + KV events (kv_manager),
 - preemption by recompute when pages run out (vLLM semantics),
+- kernel provenance: which attention / MoE implementation was selected (and why a
+  fallback fired) is recorded on the engine and surfaced by bench.py — a perf
+  number without kernel provenance is undiagnosable,
 - P/D roles: ``role=prefill`` stops after prompt processing and exports KV metadata
   (disagg connector picks it up); ``role=decode`` can import KV (disagg/transfer.py).
 """
@@ -31,7 +38,14 @@ from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.kv_manager import PageAllocator, Sequence
 from llmd_tpu.engine.sampling import sample_tokens
 from llmd_tpu.models.config import ModelConfig
-from llmd_tpu.models.transformer import forward, init_cache, init_params, param_logical_axes
+from llmd_tpu.models.transformer import (
+    forward_core,
+    init_cache,
+    init_params,
+    param_logical_axes,
+    ragged_paged_attention_xla,
+    unembed,
+)
 from llmd_tpu.parallel.mesh import build_mesh
 
 
@@ -55,6 +69,8 @@ class EngineStats:
     total_preemptions: int = 0
     total_offload_loads: int = 0  # blocks pulled back from CPU/FS tiers
     eplb_rebalances: int = 0  # wide-EP expert-placement recomputes
+    attn_backend: str = ""  # kernel provenance (bench/debug)
+    moe_backend: str = ""
 
 
 class LLMEngine:
@@ -86,6 +102,7 @@ class LLMEngine:
                 engine_cfg.cpu_offload_pages,
                 staging_blocks=engine_cfg.offload_staging_blocks,
                 fs_backend=fs, event_sink=event_sink,
+                pages_per_layer=engine_cfg.num_pages,
             )
             self.alloc.evict_hook = lambda h, pid: self.offload.on_evict(self.cache, h, pid)
         self.waiting: deque[Sequence] = deque()
@@ -106,8 +123,9 @@ class LLMEngine:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            # combined-head dim (2*Hk) shards over tp: K/V pairs stay together
             self.cache = jax.device_put(
-                self.cache, NamedSharding(self.mesh, P(None, None, None, None, "tp", None))
+                self.cache, NamedSharding(self.mesh, P(None, None, "tp", None))
             )
 
         self._eplb = None
@@ -140,8 +158,13 @@ class LLMEngine:
         mesh = self.mesh
         attn = self._select_attn_impl()
         moe_impl = self._select_moe_impl()
+        self.stats.attn_backend = self.attn_backend
+        self.stats.moe_backend = self.moe_backend
         use_lora = self.lora_registry is not None
         lora_scale = engine_cfg.lora.scale if use_lora else 1.0
+        NT = self.cfg.batched_tokens
+        B = engine_cfg.max_batch_size
+        k_steps = max(1, engine_cfg.decode_steps)
 
         def _bind(x, *axes):
             """GSPMD sharding constraint by mesh axis names (no-op off-mesh)."""
@@ -151,29 +174,24 @@ class LLMEngine:
 
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))
 
-        def _prefill(params, cache, tokens, positions, page_table, kv_len, lora_idx):
-            # sequence-parallel long-context prefill: chunk dim sharded over sp
-            tokens = _bind(tokens, "sp")
-            positions = _bind(positions, "sp")
-            logits, cache, cnt = forward(
-                cfg, params, cache, tokens[None], positions[None], page_table[None],
-                kv_len[None], attn_impl=attn, moe_matmul_impl=moe_impl,
-                lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
+        def _unified(params, cache, tokens, positions, seq_slots, page_tables,
+                     kv_lens, cu_q_lens, num_seqs, lora_tok):
+            """Flat mixed batch (prefill chunks + decode tokens); returns each
+            sequence's last-row logits [B, vocab]."""
+            # flat token dim shards over dp×sp jointly: data-parallel decode rows
+            # and sequence-parallel long prefills ride the same constraint
+            tokens = _bind(tokens, ("dp", "sp"))
+            positions = _bind(positions, ("dp", "sp"))
+            seq_slots = _bind(seq_slots, ("dp", "sp"))
+            hidden, cache, cnt = forward_core(
+                cfg, params, cache, tokens, positions, seq_slots, page_tables,
+                kv_lens, cu_q_lens=cu_q_lens, num_seqs=num_seqs, attn_impl=attn,
+                moe_matmul_impl=moe_impl,
+                lora_indices=lora_tok if use_lora else None, lora_scale=lora_scale,
             )
-            return logits[0], cache, cnt
-
-        def _decode(params, cache, tokens, positions, page_tables, kv_lens, lora_idx):
-            # decode batch sharded over dp; heads/experts sharding rides on params
-            tokens = _bind(tokens, "dp")
-            positions = _bind(positions, "dp")
-            page_tables = _bind(page_tables, "dp", None)
-            kv_lens = _bind(kv_lens, "dp")
-            logits, cache, cnt = forward(
-                cfg, params, cache, tokens[:, None], positions[:, None], page_tables,
-                kv_lens, attn_impl=attn, moe_matmul_impl=moe_impl,
-                lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
-            )
-            return logits[:, 0], cache, cnt
+            last_rows = jnp.clip(cu_q_lens[1 : B + 1] - 1, 0, NT - 1)  # [B]
+            logits = unembed(cfg, params, hidden[last_rows])  # [B, vocab]
+            return logits, cache, cnt
 
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
                           temp, top_k, top_p, key, active_mask, lora_idx):
@@ -183,87 +201,111 @@ class LLMEngine:
             positions = _bind(positions, "dp")
             page_tables = _bind(page_tables, "dp", None)
             kv_lens = _bind(kv_lens, "dp")
+            seq_slots = jnp.arange(B, dtype=jnp.int32)
+            cu = jnp.arange(B + 1, dtype=jnp.int32)
+            ns = jnp.array([B], jnp.int32)
 
             def body(carry, _):
                 cache, toks, pos, lens, key = carry
-                logits, cache, cnt = forward(
-                    cfg, params, cache, toks[:, None], pos[:, None], page_tables, lens,
-                    attn_impl=attn, moe_matmul_impl=moe_impl,
-                    lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
+                hidden, cache, cnt = forward_core(
+                    cfg, params, cache, toks, pos, seq_slots, page_tables, lens,
+                    cu_q_lens=cu, num_seqs=ns, attn_impl=attn,
+                    moe_matmul_impl=moe_impl,
+                    lora_indices=lora_idx if use_lora else None,
+                    lora_scale=lora_scale,
                 )
+                logits = unembed(cfg, params, hidden)  # [B, vocab]
                 key, sub = jax.random.split(key)
-                nxt = sample_tokens(logits[:, 0].astype(jnp.float32), sub, temp, top_k, top_p)
+                nxt = sample_tokens(logits, sub, temp, top_k, top_p)
                 nxt = jnp.where(active_mask, nxt, 0)
                 pos = jnp.where(active_mask, pos + 1, pos)
                 lens = jnp.where(active_mask, lens + 1, lens)
                 return (cache, nxt, pos, lens, key), (nxt, cnt)
 
             (cache, _, _, _, _), (toks_out, cnts) = jax.lax.scan(
-                body, (cache, tokens, positions, kv_lens, key), None,
-                length=engine_cfg.decode_steps,
+                body, (cache, tokens, positions, kv_lens, key), None, length=k_steps,
             )
             return toks_out, cache, cnts.sum(0)  # [k, B], cache, [L, E]
 
-        def _embed(params, cache, tokens, positions, page_table, kv_len, lora_idx):
+        def _embed(params, cache, tokens, positions, page_tables, kv_lens,
+                   cu_q_lens, lora_idx):
             """Prefill chunk returning the sum of valid positions' final hidden
             states — the pooling accumulator for /v1/embeddings."""
-            tokens = _bind(tokens, "sp")
-            positions = _bind(positions, "sp")
-            _logits, cache, _cnt, hidden = forward(
-                cfg, params, cache, tokens[None], positions[None], page_table[None],
-                kv_len[None], attn_impl=attn, moe_matmul_impl=moe_impl,
+            tokens = _bind(tokens, ("dp", "sp"))
+            positions = _bind(positions, ("dp", "sp"))
+            seq_slots = jnp.zeros_like(tokens)
+            hidden, cache, _cnt = forward_core(
+                cfg, params, cache, tokens, positions, seq_slots, page_tables,
+                kv_lens, cu_q_lens=cu_q_lens, num_seqs=jnp.array([1], jnp.int32),
+                attn_impl=attn, moe_matmul_impl=moe_impl,
                 lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
-                with_hidden=True,
             )
-            valid = (positions >= 0).astype(jnp.float32)[None, :, None]
-            return jnp.sum(hidden.astype(jnp.float32) * valid, axis=(0, 1)), cache
+            valid = (positions >= 0).astype(jnp.float32)[:, None]
+            return jnp.sum(hidden.astype(jnp.float32) * valid, axis=0), cache
 
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
-        self._prefill_fn = jax.jit(_prefill, **donate)
-        self._decode_fn = jax.jit(_decode, **donate)
+        self._unified_fn = jax.jit(_unified, **donate)
         self._decode_multi_fn = jax.jit(_decode_multi, **donate)
         self._embed_fn = jax.jit(_embed, **donate)
 
+    # ------------------------------------------------------- kernel selection
     def _select_attn_impl(self):
-        """Pick the attention kernel: Pallas on TPU (after a smoke compile),
-        reference gather+mask semantics elsewhere or on kernel failure."""
-        from llmd_tpu.models.transformer import paged_attention
-
+        """Pick the attention kernel: Pallas ragged-paged-attention on TPU (after a
+        smoke compile), XLA gather+mask reference elsewhere or on kernel failure.
+        Records provenance in ``attn_backend`` / ``attn_fallback_reason``."""
+        self.attn_fallback_reason: Optional[str] = None
         mode = self.cfg.attn_impl
         if mode == "reference":
-            return paged_attention
+            self.attn_backend = "xla_reference"
+            return ragged_paged_attention_xla
         want_pallas = mode == "pallas" or (
             mode == "auto" and jax.default_backend() == "tpu"
         )
         if not want_pallas:
-            return paged_attention
-        from llmd_tpu.ops.paged_attention import paged_attention_pallas
+            self.attn_backend = "xla_reference"
+            self.attn_fallback_reason = f"backend={jax.default_backend()} (non-TPU)"
+            return ragged_paged_attention_xla
+        from llmd_tpu.ops.paged_attention import paged_attention_tpu
 
         try:  # smoke-compile on tiny shapes so a Mosaic failure can't strand serving
+            from llmd_tpu.models.transformer import padded_head_dim
+
             c = self.model_cfg
-            q = jnp.zeros((1, 1, c.num_heads, c.head_dim), c.jax_dtype)
-            cache = jnp.zeros((2, 2, self.cfg.page_size, c.num_kv_heads, c.head_dim),
-                              c.jax_dtype)
-            pt = jnp.zeros((1, 1), jnp.int32)
-            paged_attention_pallas(
-                q, cache, pt, jnp.zeros((1, 1), jnp.int32), jnp.ones((1,), jnp.int32)
+            dhp = padded_head_dim(c.head_dim)
+            ps = self.cfg.page_size
+            q = jnp.zeros((1, c.num_heads, dhp), c.jax_dtype)
+            cache = jnp.zeros((2, ps, 2 * c.num_kv_heads, dhp), c.jax_dtype)
+            paged_attention_tpu(
+                q, cache, jnp.zeros((1, 2), jnp.int32), jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),
+                scale=c.head_dim ** -0.5,
+                cu_q_lens=jnp.array([0, 1], jnp.int32),
+                num_seqs=jnp.array([1], jnp.int32),
             ).block_until_ready()
-            return paged_attention_pallas
-        except Exception:
+            self.attn_backend = "pallas_ragged_paged_attention"
+            return paged_attention_tpu
+        except Exception as e:  # noqa: BLE001 — any Mosaic/XLA compile error
             if mode == "pallas":
                 raise
-            return paged_attention
+            self.attn_backend = "xla_reference"
+            self.attn_fallback_reason = f"pallas smoke-compile failed: {type(e).__name__}: {e}"
+            return ragged_paged_attention_xla
 
     def _select_moe_impl(self):
         """Pick the MoE expert-GEMM path: Pallas grouped GEMM on TPU (after a smoke
         compile), XLA einsum elsewhere or on kernel failure."""
+        self.moe_fallback_reason: Optional[str] = None
         if not self.model_cfg.is_moe:
+            self.moe_backend = "n/a (dense model)"
             return None
         mode = self.cfg.moe_matmul
         if mode == "einsum":
+            self.moe_backend = "xla_einsum"
             return None
         want = mode == "pallas" or (mode == "auto" and jax.default_backend() == "tpu")
         if not want:
+            self.moe_backend = "xla_einsum"
+            self.moe_fallback_reason = f"backend={jax.default_backend()} (non-TPU)"
             return None
         from llmd_tpu.ops.grouped_gemm import grouped_gemm, make_moe_matmul
 
@@ -273,10 +315,13 @@ class LLMEngine:
                 jnp.zeros((2, 16, 128), self.model_cfg.jax_dtype),
                 jnp.array([1, 0], jnp.int32),
             ).block_until_ready()
+            self.moe_backend = "pallas_grouped_gemm"
             return make_moe_matmul()
-        except Exception:
+        except Exception as e:  # noqa: BLE001
             if mode == "pallas":
                 raise
+            self.moe_backend = "xla_einsum"
+            self.moe_fallback_reason = f"pallas smoke-compile failed: {type(e).__name__}: {e}"
             return None
 
     # ----------------------------------------------------------------- EPLB
@@ -618,13 +663,16 @@ class LLMEngine:
 
     # --------------------------------------------------------------- stepping
     def step(self) -> list[EngineOutput]:
-        """One engine iteration: admit → one prefill chunk (if any) → one decode batch."""
+        """One engine iteration: admit → unified mixed step (while any sequence is
+        prefilling) or fused multi-step decode."""
         self._outputs = []
         if self.offload is not None:
             self._offload_drain()
         self._try_admit()
-        self._step_prefill()
-        self._step_decode()
+        if self._prefilling_seqs():
+            self._step_unified()
+        else:
+            self._step_decode()
         self.stats.num_waiting = len(self.waiting)
         self.stats.num_running = sum(1 for s in self.running if s is not None)
         self.stats.kv_utilization = self.alloc.utilization()
@@ -654,71 +702,126 @@ class LLMEngine:
             return seq.prompt_len
         return len(seq.token_ids) - 1
 
-    def _prefilling(self) -> Optional[Sequence]:
+    def _prefilling_seqs(self) -> list[Sequence]:
         cands = [
             s for s in self.running
             if s is not None and s.num_computed < self._prefill_target(s)
         ]
-        return min(cands, key=lambda s: s.arrival_time) if cands else None
+        return sorted(cands, key=lambda s: s.arrival_time)
 
-    def _step_prefill(self) -> None:
-        seq = self._prefilling()
-        if seq is None:
+    def _decode_ready(self) -> list[Sequence]:
+        return [
+            s for s in self.running
+            if s is not None and s.num_computed == len(s.token_ids) - 1
+            and s.num_computed >= s.prompt_len
+        ]
+
+    def _step_unified(self) -> None:
+        """Pack decode tokens + prefill chunks (across sequences) into the flat
+        token budget and run ONE compiled step."""
+        NT = self.cfg.batched_tokens
+        B = self.cfg.max_batch_size
+        budget = NT
+
+        # decode rows first (keeps TPOT low while prompts stream in), then
+        # prefill chunks oldest-first
+        plan: list[tuple[Sequence, int, bool]] = []  # (seq, q_len, is_decode)
+        for s in self._decode_ready():
+            if budget <= 0 or len(plan) >= B:
+                break
+            if not self._ensure_pages(s, len(s.token_ids)):
+                if not self._preempt_one() or s.slot < 0:
+                    continue
+                if not self._ensure_pages(s, len(s.token_ids)):
+                    continue
+            plan.append((s, 1, True))
+            budget -= 1
+        for s in self._prefilling_seqs():
+            if budget <= 0 or len(plan) >= B:
+                break
+            if s.slot < 0:
+                continue  # preempted while packing decode rows
+            n = min(self.cfg.prefill_chunk, self._prefill_target(s) - s.num_computed, budget)
+            if n <= 0:
+                continue
+            if not self._ensure_pages(s, s.num_computed + n):
+                if not self._preempt_one() or s.slot < 0:
+                    continue
+                if not self._ensure_pages(s, s.num_computed + n):
+                    continue
+            plan.append((s, n, False))
+            budget -= n
+        plan = [(s, n, d) for (s, n, d) in plan if s.slot >= 0]
+        if not plan:
             return
-        chunk = self.cfg.prefill_chunk
-        start = seq.num_computed
-        n = min(chunk, self._prefill_target(seq) - start)
-        if not self._ensure_pages(seq, start + n):
-            if not self._preempt_one():
-                return
-            if seq.slot == -1 or not self._ensure_pages(seq, start + n):
-                return
 
-        toks = np.zeros((chunk,), np.int32)
-        toks[:n] = seq.token_ids[start : start + n]
-        pos = np.full((chunk,), -1, np.int32)
-        pos[:n] = np.arange(start, start + n)
-        pt = np.full((self.cfg.max_pages_per_seq,), -1, np.int32)
-        pt[: len(seq.pages)] = seq.pages
+        toks = np.zeros((NT,), np.int32)
+        pos = np.full((NT,), -1, np.int32)
+        sids = np.zeros((NT,), np.int32)
+        lora_tok = np.zeros((NT,), np.int32)
+        pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
+        lens = np.ones((B,), np.int32)
+        cu = np.zeros((B + 1,), np.int32)
+        off = 0
+        for i, (s, n, is_decode) in enumerate(plan):
+            start = len(s.token_ids) - 1 if is_decode else s.num_computed
+            toks[off : off + n] = s.token_ids[start : start + n]
+            pos[off : off + n] = np.arange(start, start + n)
+            sids[off : off + n] = i
+            lora_tok[off : off + n] = self._lora_slot(s)
+            pts[i, : len(s.pages)] = s.pages
+            lens[i] = start + n
+            off += n
+            cu[i + 1] = off
+        cu[len(plan) + 1 :] = off
 
-        logits, self.cache, cnt = self._prefill_fn(
+        logits, self.cache, cnt = self._unified_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(pt), jnp.asarray(start + n, jnp.int32),
-            jnp.asarray([self._lora_slot(seq)], jnp.int32),
+            jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(cu),
+            jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok),
         )
         if self._eplb is not None:
             self._eplb_record(cnt)
-        seq.num_computed = start + n
-        seq.maybe_commit_blocks(self.alloc)
-        self.stats.total_prefill_tokens += n
 
-        if len(seq.token_ids) == seq.prompt_len and seq.num_computed == seq.prompt_len:
-            # fresh prefill complete: sample the first token from the last prompt logits
-            self._sample_and_append([seq], logits[None, n - 1])
+        sample_list: list[tuple[int, Sequence]] = []  # (batch row, seq)
+        for i, (s, n, is_decode) in enumerate(plan):
+            if is_decode:
+                s.num_computed = len(s.token_ids)
+                s.maybe_commit_blocks(self.alloc)
+                self.stats.total_decode_tokens += 1
+                sample_list.append((i, s))
+            else:
+                s.num_computed += n
+                s.maybe_commit_blocks(self.alloc)
+                self.stats.total_prefill_tokens += n
+                if (len(s.token_ids) == s.prompt_len
+                        and s.num_computed == s.prompt_len):
+                    # fresh prefill complete: sample first token from last logits
+                    sample_list.append((i, s))
+        if sample_list:
+            self._sample_and_append(sample_list, logits)
 
     def _step_decode(self) -> None:
-        active = [
-            s for s in self.running
-            if s is not None and s.num_computed == len(s.token_ids) - 1 and s.num_computed >= s.prompt_len
-        ]
+        active = self._decode_ready()
         if not active:
             return
         B = self.cfg.max_batch_size
         k = max(1, self.cfg.decode_steps)
         # A k-step scan writes KV for positions len-1 .. len+k-2 → needs len+k-1 slots.
-        # If the pool can't cover the full horizon, degrade to single-step (horizon
-        # len) rather than preempting a sequence that could still make progress.
+        # If the pool can't cover the full horizon, degrade to a single unified step
+        # (decode rows only) rather than preempting sequences that could progress.
         if k > 1:
             ok = all(
                 self._ensure_pages(s, min(len(s.token_ids) + k - 1, self.cfg.max_model_len))
                 for s in active if s.slot >= 0
             )
             if not ok:
-                k = 1
-        if k == 1:
+                self._step_unified()
+                return
+        else:
             for s in list(active):
                 if s.slot < 0:
-                    continue  # preempted by an earlier iteration of this loop
+                    continue
                 while not self._ensure_pages(s, len(s.token_ids)):
                     if not self._preempt_one() or s.slot < 0:
                         break
@@ -733,7 +836,7 @@ class LLMEngine:
         toks = np.zeros((B,), np.int32)
         pos = np.full((B,), -1, np.int32)
         pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
-        lens = np.zeros((B,), np.int32)
+        lens = np.ones((B,), np.int32)
         lora_idx = np.zeros((B,), np.int32)
         for s in active:
             i = s.slot
@@ -742,20 +845,6 @@ class LLMEngine:
             pts[i, : len(s.pages)] = s.pages
             lens[i] = len(s.token_ids)
             lora_idx[i] = self._lora_slot(s)
-
-        if k == 1:
-            logits, self.cache, cnt = self._decode_fn(
-                self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
-                jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(lora_idx),
-            )
-            if self._eplb is not None:
-                self._eplb_record(cnt)
-            for s in active:
-                s.num_computed = len(s.token_ids)
-                s.maybe_commit_blocks(self.alloc)
-            self.stats.total_decode_tokens += len(active)
-            self._sample_and_append(active, logits, slot_indexed=True)
-            return
         self._step_decode_multi(active, toks, pos, pts, lens, lora_idx, k)
 
     def _step_decode_multi(self, active, toks, pos, pts, lens, lora_idx, k: int) -> None:
@@ -814,15 +903,14 @@ class LLMEngine:
         self._free_seq(seq)
         self.seqs.pop(seq.request_id, None)
 
-    def _sample_and_append(self, seqs: list[Sequence], logits: jax.Array, slot_indexed: bool = False) -> None:
+    def _sample_and_append(self, rows_and_seqs: list[tuple[int, "Sequence"]],
+                           logits: jax.Array) -> None:
+        """Sample one token per (row, seq) pair from row-indexed logits [B, vocab]."""
         B = logits.shape[0]
         temp = np.zeros((B,), np.float32)
         tk = np.zeros((B,), np.int32)
         tp = np.ones((B,), np.float32)
-        rows = []
-        for j, s in enumerate(seqs):
-            i = s.slot if slot_indexed else j
-            rows.append(i)
+        for i, s in rows_and_seqs:
             sp: SamplingParams = s.sampling
             temp[i] = sp.temperature
             tk[i] = sp.top_k
@@ -832,7 +920,7 @@ class LLMEngine:
             sample_tokens(logits.astype(jnp.float32), sub, jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
         )
         now = time.monotonic()
-        for s, i in zip(seqs, rows):
+        for i, s in rows_and_seqs:
             tok = int(sampled[i])
             s.token_ids.append(tok)
             if s.first_token_time is None:
@@ -860,8 +948,8 @@ class LLMEngine:
     def embed(self, token_ids: list[int], lora_id: Optional[str] = None) -> list[float]:
         """Mean-pooled, L2-normalised final hidden state (/v1/embeddings path).
 
-        Runs chunk-wise through the same compiled prefill program family (one
-        extra jit), borrowing KV pages only for the duration of the call. The
+        Runs chunk-wise through the compiled embed program (flat single-sequence
+        batches), borrowing KV pages only for the duration of the call. The
         caller serialises against the step loop (run_locked in the server).
         """
         if not token_ids:
@@ -879,11 +967,12 @@ class LLMEngine:
                 raise RuntimeError("no free KV pages for embedding request")
             pages.append(pid)
         try:
-            pt = np.full((self.cfg.max_pages_per_seq,), -1, np.int32)
-            pt[: len(pages)] = pages
-            lora_idx = jnp.asarray(
-                [self.lora_registry.slot_of(lora_id) if self.lora_registry else 0],
-                jnp.int32)
+            pt = np.full((1, self.cfg.max_pages_per_seq), -1, np.int32)
+            pt[0, : len(pages)] = pages
+            lora_idx = np.full(
+                (chunk,),
+                self.lora_registry.slot_of(lora_id) if self.lora_registry else 0,
+                np.int32)
             acc = np.zeros((self.model_cfg.hidden_size,), np.float64)
             for start in range(0, len(token_ids), chunk):
                 n = min(chunk, len(token_ids) - start)
@@ -894,7 +983,8 @@ class LLMEngine:
                 h_sum, self.cache = self._embed_fn(
                     self._run_params(), self.cache, jnp.asarray(toks),
                     jnp.asarray(pos), jnp.asarray(pt),
-                    jnp.asarray(start + n, jnp.int32), lora_idx,
+                    jnp.asarray([start + n], jnp.int32),
+                    jnp.asarray([0, n], jnp.int32), jnp.asarray(lora_idx),
                 )
                 acc += np.asarray(h_sum, np.float64)
         finally:

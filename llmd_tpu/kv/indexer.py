@@ -87,6 +87,9 @@ class KVBlockIndex:
         self.max_pods_per_key = max_pods_per_key
         self.tier_weights = dict(tier_weights or DEFAULT_TIER_WEIGHTS)
         self.spec_ttl = speculative_ttl_s
+        # adapter name → generation-scoped hash key learned from BlockStored
+        # events (see apply()); consulted by the precise prefix producer
+        self._lora_keys: dict[str, str] = {}
         self._lock = threading.RLock()
         # level 1: block_hash → level 2 (pod → entry), LRU on level 1.
         self._index: OrderedDict[int, OrderedDict[str, _PodEntry]] = OrderedDict()
@@ -107,6 +110,12 @@ class KVBlockIndex:
         with self._lock:
             self.stats.events_applied += 1
             if isinstance(event, BlockStored):
+                if event.lora_id and "@" in event.lora_id:
+                    # Engines hash blocks under the GENERATION-scoped adapter key
+                    # 'name@<weights-digest>' (engine._lora_hash_key). Learn the
+                    # mapping from the event stream so router-side producers hash
+                    # with the same term — a plain-name hash would never match.
+                    self._lora_keys[event.lora_id.split("@", 1)[0]] = event.lora_id
                 for h in event.block_hashes:
                     self._store(pod, h, event.medium, spec_expiry=0.0)
                 self.stats.blocks_stored += len(event.block_hashes)

@@ -6,9 +6,10 @@ ships — ``TPUOffloadConnector`` (``tpu_inference.offload.tpu_offload_connector
 ``kv_role: kv_both``, env ``TPU_OFFLOAD_NUM_CPU_CHUNKS`` / ``STAGING_BLOCKS`` —
 guides/agentic-serving/modelserver/tpu/vllm/patch-vllm.yaml:39,47-50).
 
-TPU-native shape: a KV page lives in the device cache as ``cache[:, :, page_id]``
-(layers-major). Offload is one host transfer of that slice; reload is one batched
-scatter back (``cache.at[:, :, pids].set``) compiled once with a fixed staging width
+TPU-native shape: the device cache is one flat layer-folded page pool
+``[L*P, ps, 2Hk, Dhp]``; a logical KV page is the row set ``{l*P + page_id}``.
+Offload is one host gather of those rows; reload is one batched scatter back
+compiled once with a fixed staging width
 so XLA never retraces. Evicted-but-offloaded blocks keep earning prefix-cache hits:
 the engine checks HBM, then CPU, then FS at admission — tiered exactly like the
 reference's gpu→cpu→fs chain, and each transition emits KV events with the right
@@ -127,17 +128,27 @@ class KVOffloadConnector:
         staging_blocks: int = 16,
         fs_backend: Optional[FSKVBackend] = None,
         event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
+        pages_per_layer: Optional[int] = None,
     ) -> None:
         self.store = CPUOffloadStore(num_cpu_chunks, fs_backend, event_sink)
         self.staging_blocks = max(1, staging_blocks)
+        # cache is the flat layer-folded pool [L*P, ps, 2Hk, Dhp]; P is needed to
+        # gather one logical page's rows across layers. None = single-layer pool.
+        self.pages_per_layer = pages_per_layer
         self._load_fn = None  # jitted, built lazily (needs cache shape)
+
+    def _layer_rows(self, cache, page_id):
+        """Row indices of logical page `page_id` across layers: l*P + page_id."""
+        P = self.pages_per_layer or cache.shape[0]
+        L = cache.shape[0] // P
+        return np.arange(L) * P + page_id
 
     # ------------------------------------------------------------------ evict
     def on_evict(self, cache, block_hash: int, page_id: int) -> None:
         """Backstop for demand outrunning the proactive drain: copy an
         about-to-be-recycled page HBM→host (one per-page device sync — the batched
         ``demote_batch`` path is the steady-state eviction route)."""
-        self.store.put(block_hash, np.asarray(cache[:, :, page_id]))
+        self.store.put(block_hash, np.asarray(cache[self._layer_rows(cache, page_id)]))
 
     def demote_batch(self, cache, pairs: list[tuple[int, int]]) -> None:
         """Offload a batch of demoted pages in ONE device-to-host gather.
@@ -150,9 +161,10 @@ class KVOffloadConnector:
         import jax
         import jax.numpy as jnp
 
-        pids = jnp.asarray(np.asarray([pid for _, pid in pairs], np.int32))
-        arr = np.asarray(jax.device_get(cache[:, :, pids]))  # [L, 2, n, ps, Hk, Dh]
-        arr = np.moveaxis(arr, 2, 0)
+        pids = np.asarray([pid for _, pid in pairs], np.int32)
+        rows = np.stack([self._layer_rows(cache, pid) for pid in pids], axis=1)  # [L, n]
+        arr = np.asarray(jax.device_get(cache[jnp.asarray(rows)]))  # [L, n, ps, 2Hk, Dhp]
+        arr = np.moveaxis(arr, 1, 0)
         for (h, _), block in zip(pairs, arr):
             self.store.put(h, np.ascontiguousarray(block))
 
@@ -177,13 +189,16 @@ class KVOffloadConnector:
         import jax.numpy as jnp
 
         if self._load_fn is None:
-            P = cache.shape[2]
+            Ptot = cache.shape[0]
+            P = self.pages_per_layer or Ptot
+            L = Ptot // P
 
             def _load(cache, blocks, pids):
                 # pids -1 → out-of-bounds index dropped by the scatter (padding)
-                idx = jnp.where(pids >= 0, pids, P)
-                return cache.at[:, :, idx].set(
-                    jnp.moveaxis(blocks, 0, 2).astype(cache.dtype), mode="drop"
+                rows = jnp.arange(L)[:, None] * P + pids[None, :]  # [L, n]
+                rows = jnp.where(pids[None, :] >= 0, rows, Ptot)
+                return cache.at[rows].set(
+                    jnp.moveaxis(blocks, 0, 1).astype(cache.dtype), mode="drop"
                 )
 
             self._load_fn = jax.jit(_load, donate_argnums=(0,))
@@ -196,7 +211,9 @@ class KVOffloadConnector:
             arrays.append(arr)
         n_loaded = len(arrays)
         S = self.staging_blocks
-        block_shape = cache.shape[:2] + cache.shape[3:]  # [L, 2, ps, Hk, Dh]
+        P = self.pages_per_layer or cache.shape[0]
+        L = cache.shape[0] // P
+        block_shape = (L,) + cache.shape[1:]  # [L, ps, 2Hk, Dhp]
         for start in range(0, n_loaded, S):
             group = arrays[start : start + S]
             pids = np.full((S,), -1, np.int32)

@@ -107,12 +107,12 @@ def make_adapter_weights(model_cfg, lora_cfg: LoRAConfig, key: jax.Array,
 
 def apply_lora(h: jax.Array, A: jax.Array, B: jax.Array, idx: jax.Array,
                scale: float) -> jax.Array:
-    """Per-row adapter delta. h: [B, T, Din]; A: [S, Din, r]; B: [S, r, Dout];
-    idx: [B] int32 slot per batch row. Returns [B, T, Dout]."""
-    Ab = A[idx]  # [B, Din, r]
-    Bb = B[idx]  # [B, r, Dout]
-    xa = jnp.einsum("btd,bdr->btr", h, Ab)
-    return jnp.einsum("btr,brk->btk", xa, Bb) * scale
+    """Per-token adapter delta. h: [N, Din] flat tokens; A: [S, Din, r];
+    B: [S, r, Dout]; idx: [N] int32 slot per token. Returns [N, Dout]."""
+    Ab = A[idx]  # [N, Din, r]
+    Bb = B[idx]  # [N, r, Dout]
+    xa = jnp.einsum("nd,ndr->nr", h, Ab)
+    return jnp.einsum("nr,nrk->nk", xa, Bb) * scale
 
 
 class LoRARegistry:

@@ -3,27 +3,52 @@
 TPU-first design choices:
 - Weights stacked ``[num_layers, ...]`` and the layer stack runs under ``lax.scan`` —
   one trace/compile regardless of depth, XLA pipelines the layers.
-- All shapes static: chunked prefill processes fixed-size chunks, decode processes a
-  fixed slot batch; page tables are fixed-width. No data-dependent control flow.
+- All shapes static: the engine packs work into a fixed flat token budget; page
+  tables are fixed-width. No data-dependent control flow.
+- **Flat token batch** (vLLM-TPU style): the core takes ``tokens [N]`` holding a
+  *mixed* batch — several sequences' prefill chunks plus decode tokens — described by
+  ``cu_q_lens``/``num_seqs``. One compiled program serves chunked prefill, batched
+  prefill across sequences, and decode; this is what lets the engine pack a full
+  ``max-num-batched-tokens`` budget per step instead of one sequence's chunk.
+- KV cache layout ``[L*P, page_size, 2*Hk, Dhp]`` — ONE flat page pool with the
+  layer folded into the page dimension (layer ``l``'s page ``p`` lives at row
+  ``l*P + p``), K/V interleaved per head (K at combined index 2h, V at 2h+1), and
+  head_dim padded to the 128-lane tile. This is the layout the TPU
+  ragged-paged-attention kernel consumes directly (lane padding is free — XLA's
+  HBM tiling would pad the minor dim anyway), and the layer folding is what keeps
+  the layer stack scannable: the cache threads through ``lax.scan`` as a *carry*
+  updated by in-place scatters, and each layer's attention passes the kernel
+  layer-offset page indices into the shared pool. Stacking the cache
+  ``[L, P, ...]`` as scan xs/ys instead materializes the full 134 MB layer slice
+  twice per layer per step (measured 25-90 ms/step on v5e — the silent dominant
+  cost of the round-1 engine).
 - bfloat16 everywhere on the matmul path (MXU); fp32 for softmax/rmsnorm accumulation.
 - Sharding via logical axis names bound by ``llmd_tpu.parallel.mesh.ShardingRules``:
   heads/mlp → tp, experts → ep, batch → dp (GSPMD inserts the collectives).
 
 Engine-parity note: this plays the role of vLLM's model runner on the reference's TPU
 path (vllm `tpu_inference` plugin, docker/common-versions:5-6); attention is the
-reference-semantics paged attention; the Pallas fused kernel lives in
+XLA-reference ragged paged attention below; the Pallas fused kernel lives in
 ``llmd_tpu.ops.paged_attention`` and is swapped in by the runner on TPU.
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from llmd_tpu.models.config import ModelConfig
+
+LANE = 128
+
+
+def padded_head_dim(head_dim: int) -> int:
+    """Head dim as stored in the KV cache: padded up to the 128-lane tile."""
+    return max(LANE, ((head_dim + LANE - 1) // LANE) * LANE)
+
 
 # ---------------------------------------------------------------------------
 # Parameter init + logical sharding axes
@@ -216,67 +241,87 @@ def moe_block(
 
 
 # ---------------------------------------------------------------------------
-# Paged attention (reference semantics; Pallas kernel swapped in by the runner)
+# Paged KV cache (kernel-native combined layout)
 # ---------------------------------------------------------------------------
 
 
-class PagedKVLayout(NamedTuple):
-    """cache: [L, 2, num_pages, page_size, kv_heads, head_dim] (k=0, v=1)."""
+def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> jax.Array:
+    """[L*P, page_size, 2*Hk, Dhp] flat pool: layer l's page p at row l*P + p;
+    K at combined head 2h, V at 2h+1."""
+    return jnp.zeros(
+        (cfg.num_layers * num_pages, page_size, 2 * cfg.num_kv_heads,
+         padded_head_dim(cfg.head_dim)),
+        cfg.jax_dtype,
+    )
 
-    num_pages: int
-    page_size: int
 
+def write_kv(flat_cache: jax.Array, k: jax.Array, v: jax.Array, slots: jax.Array) -> jax.Array:
+    """Write new tokens' K/V into flat cache slots (in place under donation).
 
-def write_kv(layer_cache: jax.Array, k: jax.Array, v: jax.Array, slots: jax.Array) -> jax.Array:
-    """Write new tokens' K/V into flat page slots.
-
-    layer_cache: [2, P, ps, Hk, Dh]; k/v: [T, Hk, Dh]; slots: [T] global slot ids
-    (page_id * page_size + offset). Slot -1 marks padding (dropped via clamp+where).
+    flat_cache: [S, 2*Hk, Dhp] (the pool viewed as token slots); k/v:
+    [N, Hk, Dhp] (already lane-padded); slots: [N] global slot ids
+    (layer_offset + page_id * page_size + offset). Slot -1 marks padding
+    (routed out of bounds and dropped by the scatter).
     """
-    two, Pn, ps, Hk, Dh = layer_cache.shape
-    flat = layer_cache.reshape(2, Pn * ps, Hk, Dh)
-    # Padding tokens (slot -1) are routed out of bounds and dropped by the scatter —
-    # never remap them to a real slot: a duplicate index with a real write has
-    # undefined winner ordering.
-    idx = jnp.where(slots >= 0, slots, Pn * ps)
-    kv = jnp.stack([k, v]).astype(flat.dtype)  # [2, T, Hk, Dh]
-    flat = flat.at[:, idx].set(kv, mode="drop")
-    return flat.reshape(2, Pn, ps, Hk, Dh)
+    S, HkC, Dhp = flat_cache.shape
+    idx = jnp.where(slots >= 0, slots, S)
+    # interleave K/V per head: [N, Hk, 2, Dhp] → [N, 2*Hk, Dhp], K even / V odd
+    kv = jnp.stack([k, v], axis=2).reshape(k.shape[0], HkC, Dhp).astype(flat_cache.dtype)
+    return flat_cache.at[idx].set(kv, mode="drop")
 
 
-def paged_attention(
-    q: jax.Array,  # [B, T, H, Dh]
-    layer_cache: jax.Array,  # [2, P, ps, Hk, Dh]
-    page_tables: jax.Array,  # [B, max_pages]
-    q_positions: jax.Array,  # [B, T] global positions of queries (-1 pad)
-    kv_lens: jax.Array,  # [B] total tokens in cache per seq (incl. new)
+def ragged_paged_attention_xla(
+    q: jax.Array,  # [N, H, Dhp] flat query tokens (lane-padded)
+    layer_cache: jax.Array,  # [P, ps, 2*Hk, Dhp]
+    page_tables: jax.Array,  # [B, max_pages] (-1 = unmapped)
+    positions: jax.Array,  # [N] global positions (-1 = padding row)
+    seq_slots: jax.Array,  # [N] owning batch row per token
+    kv_lens: jax.Array,  # [B] tokens resident incl. this step's
+    *,
+    scale: float,
+    cu_q_lens: Optional[jax.Array] = None,  # unused (uniform impl signature)
+    num_seqs: Optional[jax.Array] = None,  # unused (uniform impl signature)
 ) -> jax.Array:
-    """Reference-semantics ragged paged attention (gather + mask).
+    """Reference-semantics ragged paged attention (gather + mask), jittable anywhere.
 
-    Every query attends to its sequence's cache slots with causal masking by global
-    position. Static shapes: S = max_pages * page_size keys are gathered and masked.
+    Scores every query against the ENTIRE page pool and masks by ownership + causal
+    position — O(N * P * ps) memory, fine at test scale; on TPU the Pallas kernel
+    (llmd_tpu.ops.paged_attention) replaces this with per-sequence KV streaming.
     """
-    B, T, H, Dh = q.shape
-    _, Pn, ps, Hk, _ = layer_cache.shape
-    S = page_tables.shape[1] * ps
-    kc, vc = layer_cache[0], layer_cache[1]
-    safe_pages = jnp.where(page_tables >= 0, page_tables, 0)
-    k = kc[safe_pages].reshape(B, S, Hk, Dh)  # [B, S, Hk, Dh]
-    v = vc[safe_pages].reshape(B, S, Hk, Dh)
-
+    N, H, Dhp = q.shape
+    Pn, ps, HkC, _ = layer_cache.shape
+    Hk = HkC // 2
+    B, maxp = page_tables.shape
     qpk = H // Hk
-    qg = q.reshape(B, T, Hk, qpk, Dh)
-    scores = jnp.einsum("bthqd,bshd->bhqts", qg.astype(jnp.float32), k.astype(jnp.float32))
-    scores *= Dh ** -0.5
 
-    key_pos = jnp.arange(S)[None, :]  # [1, S]
-    valid_key = key_pos < kv_lens[:, None]  # [B, S]
-    causal = key_pos[:, None, :] <= q_positions[..., None]  # [B, T, S]
-    mask = (valid_key[:, None, :] & causal & (q_positions[..., None] >= 0))  # [B, T, S]
-    scores = jnp.where(mask[:, None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqts,bshd->bthqd", probs.astype(v.dtype), v)
-    return out.reshape(B, T, H, Dh)
+    flat = layer_cache.reshape(Pn * ps, HkC, Dhp)
+    kc, vc = flat[:, 0::2], flat[:, 1::2]  # [S_all, Hk, Dhp]
+
+    # slot ownership/position maps: page p owned by row b at page-index i
+    rows = jnp.repeat(jnp.arange(B), maxp)
+    safe_pt = jnp.where(page_tables >= 0, page_tables, Pn).reshape(-1)
+    page_index = jnp.zeros((B, Pn + 1), jnp.int32).at[rows, safe_pt].set(
+        jnp.tile(jnp.arange(maxp, dtype=jnp.int32), B), mode="drop"
+    )[:, :Pn]
+    owned = jnp.zeros((B, Pn + 1), jnp.bool_).at[rows, safe_pt].set(True, mode="drop")[:, :Pn]
+
+    qg = q.reshape(N, Hk, qpk, Dhp)
+    s = jnp.einsum("nkqd,skd->nkqs", qg.astype(jnp.float32), kc.astype(jnp.float32)) * scale
+
+    slot_page = jnp.arange(Pn * ps) // ps  # [S_all]
+    key_pos = page_index[:, slot_page] * ps + (jnp.arange(Pn * ps) % ps)[None, :]  # [B, S_all]
+    b = jnp.clip(seq_slots, 0, B - 1)
+    mask = (
+        owned[b][:, slot_page.astype(jnp.int32)]
+        & (key_pos[b] <= positions[:, None])
+        & (key_pos[b] < kv_lens[b][:, None])
+        & (positions[:, None] >= 0)
+    )  # [N, S_all]
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully masked (padding) rows: softmax is uniform garbage; caller ignores them
+    out = jnp.einsum("nkqs,skd->nkqd", p.astype(vc.dtype), vc)
+    return out.reshape(N, H, Dhp)
 
 
 # ---------------------------------------------------------------------------
@@ -284,40 +329,48 @@ def paged_attention(
 # ---------------------------------------------------------------------------
 
 
-def forward(
+def forward_core(
     cfg: ModelConfig,
     params: dict[str, jax.Array],
-    cache: jax.Array,  # [L, 2, P, ps, Hk, Dh]
-    tokens: jax.Array,  # [B, T]
-    positions: jax.Array,  # [B, T] (-1 pad)
+    cache: jax.Array,  # [L*P, ps, 2*Hk, Dhp] flat layer-folded pool
+    tokens: jax.Array,  # [N] flat mixed batch
+    positions: jax.Array,  # [N] (-1 pad)
+    seq_slots: jax.Array,  # [N] owning batch row (for page lookup / masks)
     page_tables: jax.Array,  # [B, max_pages]
     kv_lens: jax.Array,  # [B] cache length AFTER this step's tokens
-    attn_impl=paged_attention,
+    cu_q_lens: Optional[jax.Array] = None,  # [B+1] (Pallas kernel path)
+    num_seqs: Optional[jax.Array] = None,  # [1] (Pallas kernel path)
+    attn_impl=None,
     moe_matmul_impl=None,
-    lora_indices: Optional[jax.Array] = None,  # [B] adapter slot per row (0 = none)
+    lora_indices: Optional[jax.Array] = None,  # [N] adapter slot per token (0 = none)
     lora_scale: float = 1.0,
-    with_hidden: bool = False,  # append final-norm hidden states (embeddings path)
-) -> tuple[jax.Array, ...]:
-    """Run tokens through the model, writing K/V into the paged cache.
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Run a flat mixed batch through the model, writing K/V into the paged cache.
 
-    Serves both chunked prefill (T = chunk) and decode (T = 1): the engine packs
-    whatever fits. Returns (logits [B, T, vocab], updated cache, expert_counts)
-    where expert_counts is the per-layer routed-token stat [L, E] int32 feeding
-    the EPLB load tracker ([L, 0] for dense models — callers ignore it freely).
+    Serves batched/chunked prefill and decode in ONE program: the engine packs
+    whatever fits its token budget. Returns (hidden [N, D] final-normed, updated
+    cache, expert_counts [L, E]). Callers unembed whichever rows they need (the
+    engine only unembeds each sequence's last row — prefill never pays the full
+    [N, vocab] logits matmul).
 
     EPLB mode: when ``params`` carries ``eplb_replica_slots``/``eplb_replica_counts``
     (engine-injected, see engine's rebalance path), ``moe_wi``/``moe_wo`` are physical
     slot weights and dispatch spreads tokens over replicas.
     """
-    B, T = tokens.shape
-    ps = cache.shape[3]
-    x = params["embed"][tokens].astype(cfg.jax_dtype)  # [B, T, D]
+    N = tokens.shape[0]
+    Ptot, ps, HkC, Dhp = cache.shape
+    Dh = cfg.head_dim
+    P = Ptot // cfg.num_layers  # pages per layer
+    B = page_tables.shape[0]
+    if attn_impl is None:
+        attn_impl = ragged_paged_attention_xla
+    x = params["embed"][tokens].astype(cfg.jax_dtype)  # [N, D]
 
-    # global slot ids for the new tokens: page_table[pos // ps] * ps + pos % ps
+    # global slot ids for the new tokens: page_table[seq, pos // ps] * ps + pos % ps
+    b = jnp.clip(seq_slots, 0, B - 1)
     pidx = jnp.where(positions >= 0, positions, 0) // ps
-    safe_page = jnp.take_along_axis(jnp.where(page_tables >= 0, page_tables, 0), pidx, axis=1)
-    slots = jnp.where(positions >= 0, safe_page * ps + positions % ps, -1)  # [B, T]
-    flat_slots = slots.reshape(B * T)
+    safe_page = jnp.where(page_tables >= 0, page_tables, 0)[b, pidx]
+    slots = jnp.where(positions >= 0, safe_page * ps + positions % ps, -1)  # [N]
 
     stacked_keys = ("attn_norm", "mlp_norm", "wq", "wk", "wv", "wo") + (
         ("router", "moe_wi", "moe_wo") + (("shared_wi", "shared_wo") if cfg.moe_num_shared_experts else ())
@@ -332,34 +385,46 @@ def forward(
 
         stacked_keys += tuple(f"lora_{ab}_{t}" for t in LORA_TARGETS for ab in "AB")
         if lora_indices is None:
-            lora_indices = jnp.zeros((B,), jnp.int32)
+            lora_indices = jnp.zeros((N,), jnp.int32)
     layer_params = {k: params[k] for k in stacked_keys}
 
+    def pad_heads(t):  # [N, h, Dh] → [N, h, Dhp]
+        if Dhp == Dh:
+            return t
+        return jnp.pad(t, ((0, 0), (0, 0), (0, Dhp - Dh)))
+
     def body(carry, scanned):
-        x, _ = carry
-        lp, cache_l = scanned  # per-layer params + this layer's cache [2, P, ps, Hk, Dh]
+        x, flat_cache = carry  # flat_cache: [L*P*ps, 2Hk, Dhp] slot view (in-place carry)
+        lp, l = scanned  # per-layer params + layer index
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("btd,dhk->bthk", h, lp["wq"])
-        k = jnp.einsum("btd,dhk->bthk", h, lp["wk"])
-        v = jnp.einsum("btd,dhk->bthk", h, lp["wv"])
+        q = jnp.einsum("nd,dhk->nhk", h, lp["wq"])
+        k = jnp.einsum("nd,dhk->nhk", h, lp["wk"])
+        v = jnp.einsum("nd,dhk->nhk", h, lp["wv"])
         if has_lora:
             from llmd_tpu.models.lora import apply_lora
 
-            Hq, Hk, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+            Hq, Hkn = cfg.num_heads, cfg.num_kv_heads
             q = q + apply_lora(h, lp["lora_A_wq"], lp["lora_B_wq"], lora_indices,
-                               lora_scale).reshape(B, T, Hq, Dh)
+                               lora_scale).reshape(N, Hq, Dh)
             k = k + apply_lora(h, lp["lora_A_wk"], lp["lora_B_wk"], lora_indices,
-                               lora_scale).reshape(B, T, Hk, Dh)
+                               lora_scale).reshape(N, Hkn, Dh)
             v = v + apply_lora(h, lp["lora_A_wv"], lp["lora_B_wv"], lora_indices,
-                               lora_scale).reshape(B, T, Hk, Dh)
+                               lora_scale).reshape(N, Hkn, Dh)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
-        cache_l = write_kv(cache_l, k.reshape(B * T, cfg.num_kv_heads, cfg.head_dim),
-                           v.reshape(B * T, cfg.num_kv_heads, cfg.head_dim), flat_slots)
-        attn = attn_impl(q, cache_l, page_tables, positions, kv_lens)
-        o = jnp.einsum("bthk,hkd->btd", attn, lp["wo"])
+        # this layer's slice of the pool: slots/pages shifted by the layer offset
+        slots_l = jnp.where(slots >= 0, slots + l * (P * ps), -1)
+        pt_l = jnp.where(page_tables >= 0, page_tables + l * P, -1)
+        flat_cache = write_kv(flat_cache, pad_heads(k), pad_heads(v), slots_l)
+        attn = attn_impl(
+            pad_heads(q), flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
+            positions, seq_slots, kv_lens,
+            cu_q_lens=cu_q_lens, num_seqs=num_seqs, scale=Dh ** -0.5,
+        )
+        attn = attn[..., :Dh]
+        o = jnp.einsum("nhk,hkd->nd", attn, lp["wo"])
         if has_lora:
-            attn_flat = attn.reshape(B, T, cfg.num_heads * cfg.head_dim)
+            attn_flat = attn.reshape(N, cfg.num_heads * Dh)
             o = o + apply_lora(attn_flat, lp["lora_A_wo"], lp["lora_B_wo"],
                                lora_indices, lora_scale)
         x = x + o
@@ -372,30 +437,62 @@ def forward(
                 else None
             )
             y, cnt = moe_block(
-                cfg, h.reshape(B * T, -1), lp["router"], lp["moe_wi"], lp["moe_wo"],
+                cfg, h, lp["router"], lp["moe_wi"], lp["moe_wo"],
                 eplb=eplb, matmul_impl=moe_matmul_impl,
-                token_mask=(positions >= 0).reshape(B * T),
+                token_mask=(positions >= 0),
             )
-            y = y.reshape(B, T, -1)
             if cfg.moe_num_shared_experts:
                 y = y + swiglu(h, lp["shared_wi"], lp["shared_wo"])
         else:
             cnt = jnp.zeros((0,), jnp.int32)
             y = swiglu(h, lp["wi"], lp["wo_mlp"])
         x = x + y
-        return (x, 0), (cache_l, cnt)
+        return (x, flat_cache), cnt
 
-    (x, _), (new_cache, expert_counts) = lax.scan(body, (x, 0), (layer_params, cache))
-    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    unembed = params["embed"].T if cfg.tie_embeddings else params["unembed"]
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), unembed.astype(jnp.float32))
-    if with_hidden:
-        return logits, new_cache, expert_counts, x
-    return logits, new_cache, expert_counts
-
-
-def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> jax.Array:
-    return jnp.zeros(
-        (cfg.num_layers, 2, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim),
-        cfg.jax_dtype,
+    (x, flat_cache), expert_counts = lax.scan(
+        body,
+        (x, cache.reshape(Ptot * ps, HkC, Dhp)),
+        (layer_params, jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    return x, flat_cache.reshape(Ptot, ps, HkC, Dhp), expert_counts
+
+
+def unembed(cfg: ModelConfig, params: dict[str, jax.Array], hidden: jax.Array) -> jax.Array:
+    """hidden [..., D] → logits [..., vocab] (fp32)."""
+    w = params["embed"].T if cfg.tie_embeddings else params["unembed"]
+    return jnp.einsum("...d,dv->...v", hidden.astype(jnp.float32), w.astype(jnp.float32))
+
+
+def forward(
+    cfg: ModelConfig,
+    params: dict[str, jax.Array],
+    cache: jax.Array,  # [L, P, ps, 2*Hk, Dhp]
+    tokens: jax.Array,  # [B, T]
+    positions: jax.Array,  # [B, T] (-1 pad)
+    page_tables: jax.Array,  # [B, max_pages]
+    kv_lens: jax.Array,  # [B] cache length AFTER this step's tokens
+    attn_impl=None,
+    moe_matmul_impl=None,
+    lora_indices: Optional[jax.Array] = None,  # [B] adapter slot per row (0 = none)
+    lora_scale: float = 1.0,
+    with_hidden: bool = False,
+) -> tuple[jax.Array, ...]:
+    """[B, T]-shaped convenience wrapper over ``forward_core`` (tests, entrypoints).
+
+    Flattens row-major and uses the XLA-reference attention (positions/seq_slots
+    carry the ragged structure, so intra-row padding is fine). Returns full logits
+    [B, T, vocab] like the classic contract.
+    """
+    B, T = tokens.shape
+    seq_slots = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)
+    lora_tok = jnp.repeat(lora_indices, T) if lora_indices is not None else None
+    hidden, new_cache, counts = forward_core(
+        cfg, params, cache, tokens.reshape(-1), positions.reshape(-1), seq_slots,
+        page_tables, kv_lens, attn_impl=None, moe_matmul_impl=moe_matmul_impl,
+        lora_indices=lora_tok, lora_scale=lora_scale,
+    )
+    logits = unembed(cfg, params, hidden).reshape(B, T, -1)
+    if with_hidden:
+        return logits, new_cache, counts, hidden.reshape(B, T, -1)
+    return logits, new_cache, counts

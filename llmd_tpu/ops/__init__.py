@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the hot ops (the reference's FlashInfer/DeepGEMM slot,
 SURVEY.md §2.5 N7-N8)."""
 
-from llmd_tpu.ops.paged_attention import paged_attention_pallas
+from llmd_tpu.ops.paged_attention import paged_attention_tpu
 
-__all__ = ["paged_attention_pallas"]
+__all__ = ["paged_attention_tpu"]

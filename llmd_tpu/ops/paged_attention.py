@@ -1,24 +1,26 @@
-"""Pallas ragged paged attention: the TPU-native answer to FlashInfer (SURVEY.md §2.5
+"""TPU ragged paged attention: the TPU-native answer to FlashInfer (SURVEY.md §2.5
 N8, docker/Dockerfile.cuda:70-71).
 
-Design (flash-decoding over a paged KV cache):
-- grid ``(batch, kv_head)``; each program owns one sequence × one KV-head group and
-  streams that sequence's pages HBM→VMEM with async DMA, ``pages_per_tile`` pages per
-  iteration (tiles sized to the 128-lane MXU width),
-- page indirection rides on **scalar prefetch**: the page table is available before
-  the body runs, so DMA source addresses are computed in SMEM — no gather
-  materialization of ``[B, S, Hk, Dh]`` in HBM (the reference-semantics fallback in
-  ``models.transformer.paged_attention`` does exactly that gather; this kernel
-  replaces it on TPU),
-- online softmax (running max/sum) in fp32 VMEM scratch — single pass over KV, no
-  ``[B, T, S]`` score materialization,
-- tiles past ``kv_len`` are skipped entirely (``@pl.when``) — ragged batches pay for
-  the KV they have, not the padded maximum,
-- GQA: queries are regrouped to ``[B, Hk, T*q_per_kv, Dh]`` outside so each program's
-  matmuls run over all queries sharing its KV head.
+The heavy lifting is the Pallas ragged-paged-attention kernel that ships with JAX
+(`jax.experimental.pallas.ops.tpu.ragged_paged_attention` — the vLLM-TPU production
+kernel): flash-decoding over a paged KV cache with double-buffered HBM→VMEM page
+streaming, online softmax, and mixed prefill+decode in one flat token batch. This
+module owns the serving-stack integration:
 
-Decode (T=1) is HBM-bandwidth-bound: the win is streaming KV once at full bandwidth.
-Prefill chunks (T=chunk) reuse the same kernel with more query rows per program.
+- the uniform attention-impl signature shared with the XLA-reference fallback
+  (`models.transformer.ragged_paged_attention_xla`) so the engine can swap impls,
+- **block-size selection**: the upstream tuned table has no entry for every
+  (chip, shape) pair and its default (128 KV pages/block) is pathological for
+  decode — measured on v5e (llama-1b shapes, B=32, kv_len 384): default blocks
+  1,676 µs/layer vs 15-18 µs/layer with (bkv=8, bq=32). We clamp KV pages per
+  block to the sequence page budget and keep it small,
+- the VMEM budget (the kernel's scratch exceeds the 16 MB scoped-vmem default on
+  larger head counts; vLLM-TPU ships 100 MB, we follow),
+- the combined KV layout contract [P, page_size, 2*Hk, Dhp] (K even / V odd) with
+  head_dim lane-padded — see `models.transformer.init_cache`.
+
+Requires queries to be each sequence's LAST `q_len` tokens (true for chunked
+prefill and decode — causality is derived as kv_len - q_len + local index).
 """
 
 from __future__ import annotations
@@ -28,158 +30,62 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
+VMEM_LIMIT = 100 * 1024 * 1024
 
 
-def _attn_kernel(
-    # scalar prefetch
-    pt_ref,  # [B, max_pages] int32 page table (SMEM)
-    len_ref,  # [B] int32 kv lengths (SMEM)
-    # inputs
-    q_ref,  # [1, 1, R, Dh] queries for (b, kh), R = T * q_per_kv (VMEM)
-    pos_ref,  # [1, R, 1] int32 query positions, -1 = padding (VMEM, column layout)
-    k_hbm,  # [P, ps, Hk, Dh] key pages (stays in HBM)
-    v_hbm,  # [P, ps, Hk, Dh] value pages (stays in HBM)
-    # outputs
-    o_ref,  # [1, 1, R, Dh] (VMEM)
-    # scratch
-    k_buf,  # [kv_tile, Dh] (VMEM)
-    v_buf,  # [kv_tile, Dh] (VMEM)
-    acc,  # [R, Dh] f32
-    m_s,  # [R, 128] f32 running max (lane-replicated)
-    l_s,  # [R, 128] f32 running sum (lane-replicated)
-    sems,  # DMA sems [2, pages_per_tile]
+@functools.cache
+def _kernel():
+    from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+        ragged_paged_attention as rpa,
+    )
+
+    return rpa
+
+
+def pick_block_sizes(num_tokens: int, page_size: int, pages_per_seq: int) -> tuple[int, int]:
+    """(num_kv_pages_per_block, num_queries_per_block) for our serving shapes.
+
+    KV blocks sized ~128 tokens keep decode DMAs overlapped without predicating
+    past short sequences (v5e sweep above); q blocks of 32 cover a full decode
+    batch row budget per program, 64+ for big prefill batches.
+    """
+    bkv = max(1, min(pages_per_seq, max(1, 128 // page_size)))
+    bq = 32 if num_tokens <= 512 else 64
+    return bkv, min(bq, num_tokens)
+
+
+def paged_attention_tpu(
+    q: jax.Array,  # [N, H, Dhp] flat query tokens (lane-padded)
+    layer_cache: jax.Array,  # [P, ps, 2*Hk, Dhp]
+    page_tables: jax.Array,  # [B, max_pages]
+    positions: jax.Array,  # [N] (unused — causality derives from kv/cu lens)
+    seq_slots: jax.Array,  # [N] (unused on this path)
+    kv_lens: jax.Array,  # [B] tokens resident incl. this step's
     *,
-    pages_per_tile: int,
-    page_size: int,
-    max_pages: int,
     scale: float,
-):
-    b = pl.program_id(0)
-    kh = pl.program_id(1)
-    kv_tile = pages_per_tile * page_size
-    n_tiles = pl.cdiv(max_pages, pages_per_tile)
-    kv_len = len_ref[b]
-
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # [R, Dh]
-    qpos_col = pos_ref[0]  # [R, 1] — column layout avoids 1D-vector relayouts
-    R = q.shape[0]
-
-    acc[:] = jnp.zeros_like(acc)
-    m_s[:] = jnp.full_like(m_s, NEG_INF)
-    l_s[:] = jnp.zeros_like(l_s)
-
-    def tile_body(t, _):
-        base = t * kv_tile
-
-        @pl.when(base < kv_len)
-        def _():
-            # stage this tile's pages into contiguous VMEM (ragged → dense)
-            for j in range(pages_per_tile):
-                pidx = t * pages_per_tile + j
-                page = jnp.where(pidx < max_pages, pt_ref[b, pidx], 0)
-                page = jnp.maximum(page, 0)  # -1 (unmapped) → masked below
-                pltpu.make_async_copy(
-                    k_hbm.at[page, :, kh], k_buf.at[pl.ds(j * page_size, page_size), :],
-                    sems.at[0, j],
-                ).start()
-                pltpu.make_async_copy(
-                    v_hbm.at[page, :, kh], v_buf.at[pl.ds(j * page_size, page_size), :],
-                    sems.at[1, j],
-                ).start()
-            for j in range(pages_per_tile):
-                pltpu.make_async_copy(
-                    k_hbm.at[0, :, kh], k_buf.at[pl.ds(j * page_size, page_size), :],
-                    sems.at[0, j],
-                ).wait()
-                pltpu.make_async_copy(
-                    v_hbm.at[0, :, kh], v_buf.at[pl.ds(j * page_size, page_size), :],
-                    sems.at[1, j],
-                ).wait()
-
-            k = k_buf[:].astype(jnp.float32)  # [kv_tile, Dh]
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )  # [R, kv_tile]
-            key_pos = base + jax.lax.broadcasted_iota(jnp.int32, (R, kv_tile), 1)
-            mask = (key_pos < kv_len) & (key_pos <= qpos_col) & (qpos_col >= 0)
-            s = jnp.where(mask, s, NEG_INF)
-
-            m_prev = m_s[:]  # [R, 128]
-            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-            alpha = jnp.exp(m_prev - m_new)  # [R, 128]
-            p = jnp.exp(s - m_new[:, :1])  # [R, kv_tile]
-            l_s[:] = l_s[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            m_s[:] = m_new
-            pv = jax.lax.dot_general(
-                p, v_buf[:].astype(jnp.float32), (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [R, Dh]
-            acc[:] = acc[:] * alpha[:, :1] + pv
-
-        return 0
-
-    jax.lax.fori_loop(0, n_tiles, tile_body, 0)
-    l = jnp.maximum(l_s[:, :1], 1e-30)  # padding rows: l=0 → zeros, not NaN
-    o_ref[0, 0] = (acc[:] / l).astype(o_ref.dtype)
-
-
-@functools.partial(jax.jit, static_argnames=("kv_tile_target", "interpret"))
-def paged_attention_pallas(
-    q: jax.Array,  # [B, T, H, Dh]
-    layer_cache: jax.Array,  # [2, P, ps, Hk, Dh]
-    page_tables: jax.Array,  # [B, max_pages] int32 (-1 = unmapped)
-    q_positions: jax.Array,  # [B, T] int32 global positions (-1 = padding)
-    kv_lens: jax.Array,  # [B] int32 tokens resident incl. this step's
-    kv_tile_target: int = 128,
-    interpret: Optional[bool] = None,
+    cu_q_lens: jax.Array,  # [B+1] cumulative query lengths
+    num_seqs: jax.Array,  # [1]
 ) -> jax.Array:
-    """Drop-in replacement for models.transformer.paged_attention (same contract)."""
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    B, T, H, Dh = q.shape
-    _, P, ps, Hk, _ = layer_cache.shape
-    qpk = H // Hk
-    R = T * qpk
-    max_pages = page_tables.shape[1]
-    ppt = max(1, kv_tile_target // ps)
-    kv_tile = ppt * ps
-
-    # group queries by their KV head: [B, Hk, R, Dh], rows ordered (t, q-in-group)
-    qg = q.reshape(B, T, Hk, qpk, Dh).transpose(0, 2, 1, 3, 4).reshape(B, Hk, R, Dh)
-    pos = jnp.repeat(q_positions[:, :, None], qpk, axis=2).reshape(B, R, 1)
-    kc, vc = layer_cache[0], layer_cache[1]
-
-    kernel = functools.partial(
-        _attn_kernel, pages_per_tile=ppt, page_size=ps, max_pages=max_pages,
-        scale=Dh ** -0.5,
+    """Uniform-signature adapter over the Pallas kernel (drop-in for
+    models.transformer.ragged_paged_attention_xla on TPU)."""
+    del positions, seq_slots
+    N = q.shape[0]
+    _, ps, _, _ = layer_cache.shape
+    bkv, bq = pick_block_sizes(N, ps, page_tables.shape[1])
+    # -1 marks unmapped table entries in engine convention; the kernel's scalar-
+    # prefetched DMA would read out of bounds — clamp to page 0 (never attended:
+    # those entries lie at/past kv_len).
+    page_tables = jnp.maximum(page_tables, 0)
+    return _kernel()(
+        q,
+        layer_cache,
+        kv_lens.astype(jnp.int32),
+        page_tables.astype(jnp.int32),
+        cu_q_lens.astype(jnp.int32),
+        num_seqs.astype(jnp.int32),
+        sm_scale=scale,
+        num_kv_pages_per_block=bkv,
+        num_queries_per_block=bq,
+        vmem_limit_bytes=VMEM_LIMIT,
     )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, Hk),
-        in_specs=[
-            pl.BlockSpec((1, 1, R, Dh), lambda b, kh, pt, kl: (b, kh, 0, 0)),
-            pl.BlockSpec((1, R, 1), lambda b, kh, pt, kl: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, 1, R, Dh), lambda b, kh, pt, kl: (b, kh, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((kv_tile, Dh), layer_cache.dtype),
-            pltpu.VMEM((kv_tile, Dh), layer_cache.dtype),
-            pltpu.VMEM((R, Dh), jnp.float32),
-            pltpu.VMEM((R, 128), jnp.float32),
-            pltpu.VMEM((R, 128), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, ppt)),
-        ],
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hk, R, Dh), layer_cache.dtype),
-        interpret=interpret,
-    )(page_tables.astype(jnp.int32), kv_lens.astype(jnp.int32), qg, pos, kc, vc)
-    return out.reshape(B, Hk, T, qpk, Dh).transpose(0, 2, 1, 3, 4).reshape(B, T, H, Dh)

@@ -28,14 +28,16 @@ from tests.conftest import run_async
 
 
 def test_extract_insert_roundtrip():
-    cache = jnp.arange(2 * 2 * 6 * 4 * 2 * 3, dtype=jnp.float32).reshape(2, 2, 6, 4, 2, 3)
-    blocks = extract_blocks(cache, [1, 4])
-    assert blocks.shape == (2, 2, 2, 4, 2, 3)
+    # flat layer-folded pool [L*P, ps, 2Hk, Dhp] with L=2, P=6
+    cache = jnp.arange(2 * 6 * 4 * 2 * 3, dtype=jnp.float32).reshape(12, 4, 2, 3)
+    blocks = extract_blocks(cache, [1, 4], pages_per_layer=6)
+    assert blocks.shape == (2, 2, 4, 2, 3)  # [n, L, ps, 2Hk, Dhp]
     target = jnp.zeros_like(cache)
-    out = insert_blocks(target, [0, 5], blocks)
-    np.testing.assert_array_equal(np.asarray(out[:, :, 0]), np.asarray(cache[:, :, 1]))
-    np.testing.assert_array_equal(np.asarray(out[:, :, 5]), np.asarray(cache[:, :, 4]))
-    np.testing.assert_array_equal(np.asarray(out[:, :, 2]), 0)
+    out = insert_blocks(target, [0, 5], blocks, pages_per_layer=6)
+    for l in range(2):
+        np.testing.assert_array_equal(np.asarray(out[l * 6 + 0]), np.asarray(cache[l * 6 + 1]))
+        np.testing.assert_array_equal(np.asarray(out[l * 6 + 5]), np.asarray(cache[l * 6 + 4]))
+        np.testing.assert_array_equal(np.asarray(out[l * 6 + 2]), 0)
 
 
 import pytest
