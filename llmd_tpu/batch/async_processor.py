@@ -79,6 +79,16 @@ class MemoryQueuePuller(QueuePuller):
     def nack(self, item: AsyncItem) -> None:
         self._seq += 1
         heapq.heappush(self._heap, (-item.priority, time.monotonic(), self._seq, item))
+        # Wake a worker parked in get(): nack runs on the event-loop thread, so
+        # the notify (which must hold the condition lock) is scheduled as a task.
+        try:
+            asyncio.get_running_loop().create_task(self._notify_one())
+        except RuntimeError:
+            pass  # no running loop (sync caller): next put() will wake waiters
+
+    async def _notify_one(self) -> None:
+        async with self._cond:
+            self._cond.notify()
 
 
 class FileSpoolPuller(QueuePuller):

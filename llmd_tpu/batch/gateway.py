@@ -309,7 +309,10 @@ class BatchGateway:
         async def one(i: int, req: dict) -> None:
             nonlocal cancelled
             model = req["body"].get("model", row.model)
-            async with self._global_sem, self._model_sem(model):
+            # Per-model cap OUTSIDE the global cap: a hot model's excess requests
+            # queue at their own semaphore without holding global slots, so other
+            # models' traffic is never starved by one model's backlog.
+            async with self._model_sem(model), self._global_sem:
                 # cancellation/expiry checked under the semaphore — every queued
                 # request re-evaluates right before its dispatch slot
                 if cancelled or row.id in self._cancel_requested:

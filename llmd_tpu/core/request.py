@@ -57,7 +57,11 @@ def flatten_messages(messages: Sequence[dict[str, Any]]) -> str:
         if isinstance(content, list):
             pieces = []
             for part in content:
-                if part.get("type") == "text":
+                # Clients may send bare strings in the parts list; treat them as
+                # text instead of 500ing on part.get.
+                if not isinstance(part, dict):
+                    pieces.append(str(part))
+                elif part.get("type") == "text":
                     pieces.append(part.get("text", ""))
                 else:
                     h = _mm_hash(part)
@@ -75,6 +79,8 @@ def mm_hashes_from_messages(messages: Sequence[dict[str, Any]]) -> list[bytes]:
         content = m.get("content")
         if isinstance(content, list):
             for part in content:
+                if not isinstance(part, dict):
+                    continue
                 h = _mm_hash(part)
                 if h is not None:
                     hashes.append(h)

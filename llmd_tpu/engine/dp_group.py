@@ -176,12 +176,23 @@ class DPWorkerSync:
             resp = self._rpc({"cmd": "register", "rank": self.rank})
 
     def report(self, has_work: bool) -> bool:
+        """Raises OSError/ConnectionError/JSONDecodeError on coordinator outage —
+        the caller must drop to solo mode and re-register on its paced schedule.
+        (Swallowing here made DPAsyncEngine re-attempt the blocking connect every
+        step: up to timeout_s of stall per step after an outage, contradicting the
+        solo-serving degradation contract.)"""
         try:
-            return bool(self._rpc({"cmd": "report", "rank": self.rank,
-                                   "has_work": has_work})["step"])
-        except (OSError, ConnectionError, json.JSONDecodeError):
-            self.close()  # reconnect next tick; step alone meanwhile
-            return has_work
+            resp = self._rpc({"cmd": "report", "rank": self.rank,
+                              "has_work": has_work})
+        except (OSError, json.JSONDecodeError):
+            self.close()
+            raise
+        if "step" not in resp:
+            # error response (corrupted line, version skew) — same contract as a
+            # transport outage: caller deregisters and serves solo
+            self.close()
+            raise ConnectionError(f"coordinator error response: {resp!r}")
+        return bool(resp["step"])
 
     def close(self) -> None:
         if self._sock is not None:
@@ -236,7 +247,18 @@ class DPAsyncEngine(AsyncLLMEngine):
                 self._try_register()
             with self._lock:
                 has_work = self.engine.has_work()
-            step = self.worker.report(has_work) if self.registered else has_work
+            if self.registered:
+                try:
+                    step = self.worker.report(has_work)
+                except (OSError, ConnectionError, json.JSONDecodeError):
+                    # coordinator outage: serve solo at full rate and re-register
+                    # on the paced schedule (don't pay a connect timeout per step)
+                    self.registered = False
+                    self.register_failures += 1
+                    self._next_register = time.monotonic() + self.register_retry_interval_s
+                    step = has_work
+            else:
+                step = has_work
             if not step:
                 time.sleep(self._idle_sleep)
                 continue

@@ -98,6 +98,15 @@ class KVBlockIndex:
         self._pod_keys: dict[str, set[int]] = {}
         self.stats = IndexStats()
 
+    def resolve_lora_key(self, name: Optional[str]) -> Optional[str]:
+        """Adapter name → generation-scoped 'name@digest' key learned from
+        BlockStored events; falls back to the plain name before any engine has
+        published blocks for the adapter (those hashes simply won't match yet)."""
+        if not name:
+            return name
+        with self._lock:
+            return self._lora_keys.get(name, name)
+
     def _drop(self, pod: str, block_hash: int) -> None:
         keys = self._pod_keys.get(pod)
         if keys is not None:

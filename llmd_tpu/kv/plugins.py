@@ -109,7 +109,11 @@ class PrecisePrefixCacheProducer(DataProducer):
         if token_ids is None:
             token_ids = list(req.prompt_text().encode("utf-8"))
             req.state[STATE_TOKEN_IDS] = token_ids
-        keys = block_keys_for_tokens(token_ids, self.block_size, req.lora_adapter,
+        # Engines hash blocks under the generation-scoped adapter key
+        # 'name@digest' (engine._lora_hash_key); hash with the index's learned
+        # mapping or router-side keys never match engine-published ones.
+        lora_key = self.index.resolve_lora_key(req.lora_adapter)
+        keys = block_keys_for_tokens(token_ids, self.block_size, lora_key,
                                      req.mm_hashes)[: self.max_blocks]
         req.state[STATE_BLOCK_KEYS] = keys
         matches = self.index.lookup(keys, [e.address for e in endpoints])

@@ -284,8 +284,12 @@ def ragged_paged_attention_xla(
 ) -> jax.Array:
     """Reference-semantics ragged paged attention (gather + mask), jittable anywhere.
 
-    Scores every query against the ENTIRE page pool and masks by ownership + causal
-    position — O(N * P * ps) memory, fine at test scale; on TPU the Pallas kernel
+    Each query gathers ONLY its owning sequence's pages via the page table, and
+    the token axis runs in fixed-size chunks under ``lax.map`` — peak memory is
+    O(chunk * max_pages_per_seq * ps) regardless of pool size OR batch size, so
+    the fallback degrades gracefully at serving scale (the pool-wide variant
+    allocated multi-TB score tensors at bench shapes; a per-token gather would
+    duplicate a prefill's KV once per query token). On TPU the Pallas kernel
     (llmd_tpu.ops.paged_attention) replaces this with per-sequence KV streaming.
     """
     N, H, Dhp = q.shape
@@ -294,34 +298,37 @@ def ragged_paged_attention_xla(
     B, maxp = page_tables.shape
     qpk = H // Hk
 
-    flat = layer_cache.reshape(Pn * ps, HkC, Dhp)
-    kc, vc = flat[:, 0::2], flat[:, 1::2]  # [S_all, Hk, Dhp]
+    b_all = jnp.clip(seq_slots, 0, B - 1)
+    C = min(32, N)  # token chunk: bounds the per-step KV gather
+    Np = (N + C - 1) // C * C
+    qp = jnp.pad(q, ((0, Np - N), (0, 0), (0, 0))).reshape(Np // C, C, H, Dhp)
+    posp = jnp.pad(positions, (0, Np - N), constant_values=-1).reshape(Np // C, C)
+    bp = jnp.pad(b_all, (0, Np - N)).reshape(Np // C, C)
+    key_pos = jnp.arange(maxp * ps, dtype=jnp.int32)[None, :]  # [1, S]
 
-    # slot ownership/position maps: page p owned by row b at page-index i
-    rows = jnp.repeat(jnp.arange(B), maxp)
-    safe_pt = jnp.where(page_tables >= 0, page_tables, Pn).reshape(-1)
-    page_index = jnp.zeros((B, Pn + 1), jnp.int32).at[rows, safe_pt].set(
-        jnp.tile(jnp.arange(maxp, dtype=jnp.int32), B), mode="drop"
-    )[:, :Pn]
-    owned = jnp.zeros((B, Pn + 1), jnp.bool_).at[rows, safe_pt].set(True, mode="drop")[:, :Pn]
+    def one_chunk(args):
+        qc, posc, bc = args  # [C, H, Dhp], [C], [C]
+        pt = page_tables[bc]  # [C, maxp] owning sequence's pages, in order
+        kv = layer_cache[jnp.where(pt >= 0, pt, 0)]  # [C, maxp, ps, 2Hk, Dhp]
+        kv = kv.reshape(C, maxp * ps, HkC, Dhp)
+        kc, vc = kv[:, :, 0::2], kv[:, :, 1::2]  # [C, S, Hk, Dhp]
+        qg = qc.reshape(C, Hk, qpk, Dhp)
+        s = jnp.einsum("nkqd,nskd->nkqs", qg.astype(jnp.float32),
+                       kc.astype(jnp.float32)) * scale
+        # key j sits at sequence position j (page tables list pages in order)
+        mask = (
+            (pt[:, key_pos[0] // ps] >= 0)
+            & (key_pos <= posc[:, None])
+            & (key_pos < kv_lens[bc][:, None])
+            & (posc[:, None] >= 0)
+        )  # [C, S]
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        # fully masked (padding) rows: softmax is uniform garbage; caller ignores
+        return jnp.einsum("nkqs,nskd->nkqd", p.astype(vc.dtype), vc)
 
-    qg = q.reshape(N, Hk, qpk, Dhp)
-    s = jnp.einsum("nkqd,skd->nkqs", qg.astype(jnp.float32), kc.astype(jnp.float32)) * scale
-
-    slot_page = jnp.arange(Pn * ps) // ps  # [S_all]
-    key_pos = page_index[:, slot_page] * ps + (jnp.arange(Pn * ps) % ps)[None, :]  # [B, S_all]
-    b = jnp.clip(seq_slots, 0, B - 1)
-    mask = (
-        owned[b][:, slot_page.astype(jnp.int32)]
-        & (key_pos[b] <= positions[:, None])
-        & (key_pos[b] < kv_lens[b][:, None])
-        & (positions[:, None] >= 0)
-    )  # [N, S_all]
-    s = jnp.where(mask[:, None, None, :], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    # fully masked (padding) rows: softmax is uniform garbage; caller ignores them
-    out = jnp.einsum("nkqs,skd->nkqd", p.astype(vc.dtype), vc)
-    return out.reshape(N, H, Dhp)
+    out = lax.map(one_chunk, (qp, posp, bp))  # [Np//C, C, Hk, qpk, Dhp]
+    return out.reshape(Np, H, Dhp)[:N]
 
 
 # ---------------------------------------------------------------------------
@@ -472,7 +479,6 @@ def forward(
     positions: jax.Array,  # [B, T] (-1 pad)
     page_tables: jax.Array,  # [B, max_pages]
     kv_lens: jax.Array,  # [B] cache length AFTER this step's tokens
-    attn_impl=None,
     moe_matmul_impl=None,
     lora_indices: Optional[jax.Array] = None,  # [B] adapter slot per row (0 = none)
     lora_scale: float = 1.0,
@@ -480,9 +486,10 @@ def forward(
 ) -> tuple[jax.Array, ...]:
     """[B, T]-shaped convenience wrapper over ``forward_core`` (tests, entrypoints).
 
-    Flattens row-major and uses the XLA-reference attention (positions/seq_slots
-    carry the ragged structure, so intra-row padding is fine). Returns full logits
-    [B, T, vocab] like the classic contract.
+    Flattens row-major and ALWAYS uses the XLA-reference attention — the [B, T]
+    padded layout is incompatible with the Pallas kernel's cu_q_lens contract, so
+    no attn_impl override is accepted (engine callers use forward_core directly).
+    Returns full logits [B, T, vocab] like the classic contract.
     """
     B, T = tokens.shape
     seq_slots = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)

@@ -26,7 +26,6 @@ prefill and decode — causality is derived as kv_len - q_len + local index).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

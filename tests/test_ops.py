@@ -151,6 +151,57 @@ def test_engine_unified_vs_fused_decode_paths():
     assert out_fused == out_single == out_budget
 
 
+def test_pick_block_sizes_bounds():
+    """Block-size policy invariants the kernel's static validation requires:
+    1 <= bkv <= pages_per_seq, bkv*ps targets ~128 tokens, 1 <= bq <= N."""
+    from llmd_tpu.ops.paged_attention import pick_block_sizes
+
+    for ps in (4, 8, 16, 32, 64, 128, 256):
+        for pages in (1, 2, 7, 64, 512):
+            for n in (1, 31, 512, 2048):
+                bkv, bq = pick_block_sizes(n, ps, pages)
+                assert 1 <= bkv <= pages
+                assert bkv * ps <= max(128, ps)  # ~128-token KV blocks
+                assert 1 <= bq <= max(n, 1) and bq <= 64
+
+
+def test_pallas_adapter_glue_with_stub_kernel(monkeypatch):
+    """CPU-runnable check of paged_attention_tpu's adapter logic (arg mapping,
+    page-table clamping, block-size forwarding) via a stub kernel — the kernel
+    itself is TPU-only but the glue must not regress silently off-TPU."""
+    import llmd_tpu.ops.paged_attention as pa
+
+    captured = {}
+
+    def stub(q, kv, kv_lens, page_tables, cu_q_lens, num_seqs, **kw):
+        captured.update(kw, q=q, kv=kv, kv_lens=kv_lens,
+                        page_tables=page_tables, cu_q_lens=cu_q_lens,
+                        num_seqs=num_seqs)
+        return jnp.zeros_like(q)
+
+    monkeypatch.setattr(pa, "_kernel", lambda: stub)
+    q, kv, pt, pos, sids, lens = _mk_flat_case([40, 9, 21], [8, 1, 1], 8, 4, 128,
+                                               P=32, ps=16, max_pages=4)
+    pt = pt.copy()
+    assert (pt < 0).any(), "case must exercise unmapped (-1) page-table entries"
+    cu = np.asarray([0, 8, 9, 10], np.int32)
+    out = pa.paged_attention_tpu(
+        jnp.asarray(q), jnp.asarray(kv), jnp.asarray(pt), jnp.asarray(pos),
+        jnp.asarray(sids), jnp.asarray(lens), scale=0.11,
+        cu_q_lens=jnp.asarray(cu), num_seqs=jnp.asarray([3], np.int32))
+    assert out.shape == q.shape
+    # -1 entries clamped for the kernel's scalar-prefetched DMA
+    assert (np.asarray(captured["page_tables"]) >= 0).all()
+    np.testing.assert_array_equal(np.asarray(captured["kv_lens"]), lens)
+    np.testing.assert_array_equal(np.asarray(captured["cu_q_lens"]), cu)
+    np.testing.assert_array_equal(np.asarray(captured["num_seqs"]), [3])
+    assert captured["sm_scale"] == 0.11
+    bkv, bq = pa.pick_block_sizes(q.shape[0], 16, 4)
+    assert captured["num_kv_pages_per_block"] == bkv
+    assert captured["num_queries_per_block"] == bq
+    assert captured["vmem_limit_bytes"] == pa.VMEM_LIMIT
+
+
 @pytest.mark.tpu
 def test_pallas_kernel_matches_reference_on_tpu():
     """On real TPU hardware: the Pallas kernel must agree with the XLA reference."""
