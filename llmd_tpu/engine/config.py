@@ -47,6 +47,10 @@ class EngineConfig:
     # reference semantics elsewhere, "pallas" = force the Pallas kernel,
     # "reference" = gather+mask (models.transformer.ragged_paged_attention_xla).
     attn_impl: str = "auto"
+    # Per-phase timing attribution (bench.py): forces a device sync after each
+    # unified step so host/device/post are separable. Off in production serving —
+    # the sync serializes host packing against in-flight device work.
+    instrument: bool = False
     # MoE expert GEMMs: "auto" = Pallas grouped GEMM on TPU / einsum elsewhere,
     # "pallas" = force (interpret off-TPU), "einsum" = XLA dot path.
     moe_matmul: str = "auto"

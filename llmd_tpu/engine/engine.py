@@ -71,6 +71,16 @@ class EngineStats:
     eplb_rebalances: int = 0  # wide-EP expert-placement recomputes
     attn_backend: str = ""  # kernel provenance (bench/debug)
     moe_backend: str = ""
+    # Per-phase wall-time attribution (bench.py breakdown — every serving-perf
+    # number must be decomposable into where the time actually went):
+    time_prefill_steps: float = 0.0  # wall inside unified (mixed/prefill) steps
+    time_decode_steps: float = 0.0  # wall inside fused decode calls
+    time_host_pack: float = 0.0  # host-side batch packing (numpy staging)
+    time_device: float = 0.0  # jitted call + device sync (incl. dispatch)
+    time_device_decode: float = 0.0  # the decode-call share of time_device
+    time_postprocess: float = 0.0  # host output handling after device sync
+    n_unified_steps: int = 0
+    n_decode_calls: int = 0
 
 
 class LLMEngine:
@@ -719,6 +729,7 @@ class LLMEngine:
     def _step_unified(self) -> None:
         """Pack decode tokens + prefill chunks (across sequences) into the flat
         token budget and run ONE compiled step."""
+        t0 = time.perf_counter()
         NT = self.cfg.batched_tokens
         B = self.cfg.max_batch_size
         budget = NT
@@ -775,11 +786,15 @@ class LLMEngine:
             cu[i + 1] = off
         cu[len(plan) + 1 :] = off
 
+        t1 = time.perf_counter()
         logits, self.cache, cnt = self._unified_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(cu),
             jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok),
         )
+        if self.cfg.instrument:
+            logits.block_until_ready()
+        t2 = time.perf_counter()
         if self._eplb is not None:
             self._eplb_record(cnt)
 
@@ -800,8 +815,16 @@ class LLMEngine:
                     sample_list.append((i, s))
         if sample_list:
             self._sample_and_append(sample_list, logits)
+        t3 = time.perf_counter()
+        st = self.stats
+        st.time_host_pack += t1 - t0
+        st.time_device += t2 - t1
+        st.time_postprocess += t3 - t2
+        st.time_prefill_steps += t3 - t0
+        st.n_unified_steps += 1
 
     def _step_decode(self) -> None:
+        t0 = time.perf_counter()
         active = self._decode_ready()
         if not active:
             return
@@ -845,9 +868,12 @@ class LLMEngine:
             pts[i, : len(s.pages)] = s.pages
             lens[i] = len(s.token_ids)
             lora_idx[i] = self._lora_slot(s)
-        self._step_decode_multi(active, toks, pos, pts, lens, lora_idx, k)
+        self._step_decode_multi(active, toks, pos, pts, lens, lora_idx, k, wall_start=t0)
 
-    def _step_decode_multi(self, active, toks, pos, pts, lens, lora_idx, k: int) -> None:
+    def _step_decode_multi(self, active, toks, pos, pts, lens, lora_idx, k: int,
+                           wall_start: Optional[float] = None) -> None:
+        if wall_start is None:
+            wall_start = time.perf_counter()
         B = self.cfg.max_batch_size
         temp = np.zeros((B,), np.float32)
         tk = np.zeros((B,), np.int32)
@@ -858,6 +884,8 @@ class LLMEngine:
             temp[s.slot], tk[s.slot], tp[s.slot] = sp.temperature, sp.top_k, sp.top_p
             mask[s.slot] = True
         self._key, sub = jax.random.split(self._key)
+        t1 = time.perf_counter()
+        self.stats.time_host_pack += t1 - wall_start
         toks_out, self.cache, cnt = self._decode_multi_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(tk),
@@ -865,7 +893,8 @@ class LLMEngine:
         )
         if self._eplb is not None:
             self._eplb_record(cnt)
-        toks_out = np.asarray(toks_out)  # [k, B]
+        toks_out = np.asarray(toks_out)  # [k, B] (device sync point)
+        t2 = time.perf_counter()
         now = time.monotonic()
         for s in active:
             new = [int(t) for t in toks_out[:, s.slot]]
@@ -890,6 +919,13 @@ class LLMEngine:
                 finish_reason=reason, num_cached_prompt_tokens=s.num_cached_prompt,
                 prompt_len=s.prompt_len,
             ))
+        t3 = time.perf_counter()
+        st = self.stats
+        st.time_device += t2 - t1
+        st.time_device_decode += t2 - t1
+        st.time_postprocess += t3 - t2
+        st.time_decode_steps += t3 - wall_start
+        st.n_decode_calls += 1
 
     def _retire(self, seq: Sequence, reason: Optional[str]) -> None:
         """Shared retirement path: free slot + pages, drop from the live map."""

@@ -13,7 +13,9 @@ import os
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="tiny", help="name from llmd_tpu.models.MODEL_REGISTRY")
+    ap.add_argument("--model", default="tiny",
+                    help="registry name (llmd_tpu.models.MODEL_REGISTRY) or a local "
+                         "HF checkpoint dir (config.json + safetensors)")
     ap.add_argument("--served-model-name", default=None)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8000)
@@ -56,9 +58,9 @@ def main() -> None:
     from llmd_tpu.engine.config import EngineConfig
     from llmd_tpu.engine.server import EngineServer
     from llmd_tpu.engine.tokenizer import load_tokenizer
-    from llmd_tpu.models import get_model_config
+    from llmd_tpu.models import resolve_model
 
-    model_cfg = get_model_config(args.model)
+    model_cfg, params = resolve_model(args.model)
     engine_cfg = EngineConfig(
         page_size=args.block_size, num_pages=args.num_pages,
         max_model_len=args.max_model_len, max_batch_size=args.max_batch_size,
@@ -71,12 +73,21 @@ def main() -> None:
 
         engine_cfg.lora = LoRAConfig(max_adapters=args.max_loras,
                                      rank=args.max_lora_rank)
+    # an HF checkpoint dir carries its own tokenizer files
+    tok_path = args.tokenizer or (args.model if params is not None else None)
+    tokenizer = load_tokenizer(tok_path)
+    if params is not None and type(tokenizer).__name__ != "HFTokenizer":
+        # real weights + byte fallback = garbage completions that look healthy
+        raise SystemExit(
+            f"could not load an HF tokenizer from {tok_path!r} for real-weight "
+            "serving; pass --tokenizer <dir> with tokenizer.json present"
+        )
     server = EngineServer(
         model_cfg, engine_cfg,
-        model_name=args.served_model_name or f"llmd-tpu/{args.model}",
+        model_name=args.served_model_name or f"llmd-tpu/{model_cfg.name}",
         host=args.host, port=args.port, kv_events_port=args.kv_events_port,
         kv_transfer_port=args.kv_transfer_port,
-        tokenizer=load_tokenizer(args.tokenizer),
+        tokenizer=tokenizer, params=params,
     )
     if args.advertise_host:
         server.advertise_host = args.advertise_host

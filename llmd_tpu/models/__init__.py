@@ -8,3 +8,16 @@ RMSNorm, SwiGLU, optional top-k routed MoE with shared experts. Weights are stac
 
 from llmd_tpu.models.config import ModelConfig  # noqa: F401
 from llmd_tpu.models.registry import get_model_config, MODEL_REGISTRY  # noqa: F401
+
+
+def resolve_model(name_or_path: str, dtype: str = "bfloat16"):
+    """(ModelConfig, params|None) from a registry name OR an HF checkpoint dir.
+
+    Registry names return ``params=None`` (caller random-inits — CI shapes);
+    an HF dir loads real weights through ``llmd_tpu.models.hf_loader``.
+    """
+    from llmd_tpu.models.hf_loader import is_hf_checkpoint, load_model
+
+    if is_hf_checkpoint(name_or_path):
+        return load_model(name_or_path, dtype=dtype)
+    return get_model_config(name_or_path), None

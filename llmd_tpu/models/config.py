@@ -22,6 +22,10 @@ class ModelConfig:
     max_position: int = 32768
     tie_embeddings: bool = True
     dtype: str = "bfloat16"
+    # Per-head RMSNorm on q/k before RoPE (Qwen3-family checkpoints).
+    qk_norm: bool = False
+    # Bias terms on the q/k/v projections (Qwen2-family checkpoints).
+    attn_bias: bool = False
     # MoE (0 experts = dense). All layers share the same shape so the stack scans.
     moe_num_experts: int = 0
     moe_top_k: int = 2

@@ -1,0 +1,252 @@
+"""HF checkpoint loading: config.json → ModelConfig, safetensors → stacked params.
+
+The reference serves HF checkpoints (Qwen3-32B, Llama-70B, gpt-oss-120b —
+/root/reference/guides/optimized-baseline/README.md:22-28,
+guides/wide-ep-lws/README.md:406-414) through vLLM's weight loader; this module is
+the TPU-native equivalent feeding our scanned-stack layout
+(``llmd_tpu.models.transformer``): per-layer HF tensors are transposed into the
+matmul-ready ``[D, H, Dh]``-style orientations and stacked into single
+``[num_layers, ...]`` leaves so the layer stack runs under one ``lax.scan``.
+
+Supported architectures (config.json ``architectures[0]``):
+- ``LlamaForCausalLM`` / ``MistralForCausalLM`` — GQA, SwiGLU, optional tied embeddings
+- ``Qwen2ForCausalLM`` — adds q/k/v projection biases
+- ``Qwen3ForCausalLM`` — adds per-head q/k RMSNorm and an explicit ``head_dim``
+
+Handles single-file ``model.safetensors`` and sharded
+``model.safetensors.index.json`` checkpoints; weights are cast to the target dtype
+(bfloat16 for serving — MXU-native; float32 for parity tests against the HF
+reference implementation).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from llmd_tpu.models.config import ModelConfig
+
+_ARCH_FAMILY = {
+    "LlamaForCausalLM": "llama",
+    "MistralForCausalLM": "llama",
+    "Qwen2ForCausalLM": "qwen2",
+    "Qwen3ForCausalLM": "qwen3",
+}
+
+
+def is_hf_checkpoint(path: str) -> bool:
+    return os.path.isdir(path) and os.path.isfile(os.path.join(path, "config.json"))
+
+
+def config_from_hf(path: str, dtype: str = "bfloat16") -> ModelConfig:
+    """Translate an HF ``config.json`` into our ``ModelConfig``."""
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    archs = hf.get("architectures") or []
+    arch = archs[0] if archs else "LlamaForCausalLM"
+    family = _ARCH_FAMILY.get(arch)
+    if family is None:
+        raise ValueError(
+            f"unsupported architecture {arch!r}; supported: {sorted(_ARCH_FAMILY)}"
+        )
+    scaling = hf.get("rope_scaling")
+    if scaling and scaling.get("rope_type", scaling.get("type", "default")) != "default":
+        # Loading would succeed but produce silently wrong logits (scaled RoPE
+        # frequencies are not applied) — refuse instead.
+        raise ValueError(
+            f"unsupported rope_scaling {scaling!r} in {path}; only default RoPE "
+            "is implemented"
+        )
+    if hf.get("sliding_window") is not None and hf.get("use_sliding_window", True):
+        # Same silent-corruption class: full attention past the window would
+        # diverge from the reference implementation (Mistral-style checkpoints).
+        raise ValueError(
+            f"unsupported sliding_window={hf['sliding_window']} in {path}; "
+            "full attention only"
+        )
+    D = int(hf["hidden_size"])
+    H = int(hf["num_attention_heads"])
+    return ModelConfig(
+        name=os.path.basename(os.path.normpath(path)) or arch,
+        vocab_size=int(hf["vocab_size"]),
+        hidden_size=D,
+        intermediate_size=int(hf["intermediate_size"]),
+        num_layers=int(hf["num_hidden_layers"]),
+        num_heads=H,
+        num_kv_heads=int(hf.get("num_key_value_heads", H)),
+        head_dim=int(hf.get("head_dim") or D // H),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-6)),
+        max_position=int(hf.get("max_position_embeddings", 32768)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        dtype=dtype,
+        qk_norm=family == "qwen3",
+        # honour an explicit attention_bias on any family; qwen2's default is True
+        attn_bias=bool(hf.get("attention_bias", family == "qwen2")),
+    )
+
+
+class _TensorSource:
+    """Uniform tensor-by-name access over single-file or index-sharded safetensors.
+
+    Reads stay on HOST memory (torch-CPU framework — handles bf16, which numpy
+    can't): loading must never bounce checkpoint bytes through the accelerator;
+    only the final stacked leaves are device_put once (as the serving dtype).
+    """
+
+    def __init__(self, path: str) -> None:
+        from safetensors import safe_open
+
+        self._open = safe_open
+        self.path = path
+        self._where: dict[str, str] = {}  # tensor name → shard file
+        self._handles: dict[str, object] = {}
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.isfile(index):
+            with open(index) as f:
+                self._where = dict(json.load(f)["weight_map"])
+        else:
+            single = os.path.join(path, "model.safetensors")
+            if not os.path.isfile(single):
+                raise FileNotFoundError(
+                    f"no model.safetensors or model.safetensors.index.json in {path}"
+                )
+            with safe_open(single, framework="torch", device="cpu") as f:
+                for name in f.keys():
+                    self._where[name] = "model.safetensors"
+
+    def names(self) -> list[str]:
+        return list(self._where)
+
+    def get(self, name: str) -> np.ndarray:
+        """Tensor as host float32 ndarray."""
+        fname = self._where.get(name)
+        if fname is None:
+            raise KeyError(f"tensor {name!r} not in checkpoint {self.path}")
+        h = self._handles.get(fname)
+        if h is None:
+            h = self._handles[fname] = self._open(
+                os.path.join(self.path, fname), framework="torch", device="cpu"
+            )
+        import torch
+
+        return h.get_tensor(name).to(torch.float32).numpy()
+
+
+def load_params(
+    path: str, cfg: Optional[ModelConfig] = None, dtype: Optional[str] = None
+) -> dict[str, jax.Array]:
+    """Load + restack checkpoint weights into the scanned-layer param dict.
+
+    HF per-layer ``[out, in]`` projection matrices become matmul-ready stacked
+    leaves: ``wq [L, D, H, Dh]``, ``wo [L, H, Dh, D]``, fused SwiGLU
+    ``wi = concat(gate.T, up.T) [L, D, 2F]`` (our ``swiglu`` splits gate-first),
+    ``wo_mlp [L, F, D]``; ``unembed`` is ``lm_head.T [D, V]`` unless embeddings
+    are tied (then ``embed.T`` is used at unembed time, matching HF tying).
+    """
+    if cfg is None:
+        cfg = config_from_hf(path, dtype=dtype or "bfloat16")
+    dt = cfg.jax_dtype
+    src = _TensorSource(path)
+    D, H, Hk, Dh = cfg.hidden_size, cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    L, F = cfg.num_layers, cfg.intermediate_size
+
+    def g(name: str) -> np.ndarray:
+        return src.get(name)
+
+    def stack(fn) -> jax.Array:
+        return jnp.asarray(np.stack([fn(l) for l in range(L)]), dt)
+
+    p: dict[str, jax.Array] = {
+        "embed": jnp.asarray(g("model.embed_tokens.weight"), dt),
+        "final_norm": jnp.asarray(g("model.norm.weight"), dt),
+        "attn_norm": stack(lambda l: g(f"model.layers.{l}.input_layernorm.weight")),
+        "mlp_norm": stack(
+            lambda l: g(f"model.layers.{l}.post_attention_layernorm.weight")
+        ),
+        "wq": stack(
+            lambda l: g(f"model.layers.{l}.self_attn.q_proj.weight").T.reshape(D, H, Dh)
+        ),
+        "wk": stack(
+            lambda l: g(f"model.layers.{l}.self_attn.k_proj.weight").T.reshape(D, Hk, Dh)
+        ),
+        "wv": stack(
+            lambda l: g(f"model.layers.{l}.self_attn.v_proj.weight").T.reshape(D, Hk, Dh)
+        ),
+        "wo": stack(
+            lambda l: g(f"model.layers.{l}.self_attn.o_proj.weight").T.reshape(H, Dh, D)
+        ),
+        "wi": stack(
+            lambda l: np.concatenate(
+                [
+                    g(f"model.layers.{l}.mlp.gate_proj.weight").T,
+                    g(f"model.layers.{l}.mlp.up_proj.weight").T,
+                ],
+                axis=-1,
+            )
+        ),
+        "wo_mlp": stack(lambda l: g(f"model.layers.{l}.mlp.down_proj.weight").T),
+    }
+    if cfg.qk_norm:
+        p["q_norm"] = stack(lambda l: g(f"model.layers.{l}.self_attn.q_norm.weight"))
+        p["k_norm"] = stack(lambda l: g(f"model.layers.{l}.self_attn.k_norm.weight"))
+    if cfg.attn_bias:
+        p["bq"] = stack(
+            lambda l: g(f"model.layers.{l}.self_attn.q_proj.bias").reshape(H, Dh)
+        )
+        p["bk"] = stack(
+            lambda l: g(f"model.layers.{l}.self_attn.k_proj.bias").reshape(Hk, Dh)
+        )
+        p["bv"] = stack(
+            lambda l: g(f"model.layers.{l}.self_attn.v_proj.bias").reshape(Hk, Dh)
+        )
+        # llama-style attention_bias puts a bias on o_proj too; qwen2 does not
+        names = set(src.names())
+        p["bo"] = (
+            stack(lambda l: g(f"model.layers.{l}.self_attn.o_proj.bias"))
+            if "model.layers.0.self_attn.o_proj.bias" in names
+            else jnp.zeros((L, D), dt)
+        )
+    if not cfg.tie_embeddings:
+        p["unembed"] = jnp.asarray(g("lm_head.weight").T, dt)
+    expected_fused = D * 2 * F
+    got = p["wi"].shape[1] * p["wi"].shape[2]
+    if got != expected_fused:
+        raise ValueError(
+            f"mlp shape mismatch: fused gate/up is {p['wi'].shape}, "
+            f"config expects [L, {D}, {2 * F}]"
+        )
+    return p
+
+
+def load_model(
+    path: str, dtype: str = "bfloat16"
+) -> tuple[ModelConfig, dict[str, jax.Array]]:
+    """One-call load: (ModelConfig, stacked params) from an HF checkpoint dir."""
+    cfg = config_from_hf(path, dtype=dtype)
+    return cfg, load_params(path, cfg)
+
+
+def main() -> None:  # pragma: no cover - CLI convenience
+    import argparse
+
+    ap = argparse.ArgumentParser(description="inspect an HF checkpoint dir")
+    ap.add_argument("path")
+    args = ap.parse_args()
+    cfg = config_from_hf(args.path)
+    params = load_params(args.path, cfg)
+    n = sum(int(np.prod(v.shape)) for v in params.values())
+    print(f"{cfg.name}: {cfg.num_layers}L d={cfg.hidden_size} "
+          f"H={cfg.num_heads}/{cfg.num_kv_heads} dh={cfg.head_dim} "
+          f"vocab={cfg.vocab_size} tie={cfg.tie_embeddings} "
+          f"qk_norm={cfg.qk_norm} attn_bias={cfg.attn_bias} — "
+          f"{n / 1e9:.3f}B params")
+
+
+if __name__ == "__main__":
+    main()

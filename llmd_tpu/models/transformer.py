@@ -68,6 +68,15 @@ def param_logical_axes(cfg: ModelConfig) -> dict[str, Any]:
         "wv": ("layers", "embed", "kv_heads", "head_dim"),
         "wo": ("layers", "heads", "head_dim", "embed"),
     }
+    if cfg.qk_norm:
+        axes |= {"q_norm": ("layers", "head_dim"), "k_norm": ("layers", "head_dim")}
+    if cfg.attn_bias:
+        axes |= {
+            "bq": ("layers", "heads", "head_dim"),
+            "bk": ("layers", "kv_heads", "head_dim"),
+            "bv": ("layers", "kv_heads", "head_dim"),
+            "bo": ("layers", "embed"),
+        }
     if cfg.is_moe:
         axes |= {
             "router": ("layers", "embed", "experts"),
@@ -107,6 +116,14 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict[str, jax.Array]:
         "wv": norm((L, D, Hk, Dh), s),
         "wo": norm((L, H, Dh, D), (H * Dh) ** -0.5),
     }
+    if cfg.qk_norm:
+        p["q_norm"] = jnp.ones((L, Dh), dt)
+        p["k_norm"] = jnp.ones((L, Dh), dt)
+    if cfg.attn_bias:
+        p["bq"] = jnp.zeros((L, H, Dh), dt)
+        p["bk"] = jnp.zeros((L, Hk, Dh), dt)
+        p["bv"] = jnp.zeros((L, Hk, Dh), dt)
+        p["bo"] = jnp.zeros((L, D), dt)
     if cfg.is_moe:
         E, Fe = cfg.moe_num_experts, cfg.moe_intermediate_size or F
         p["router"] = norm((L, D, E), s)
@@ -380,6 +397,8 @@ def forward_core(
     slots = jnp.where(positions >= 0, safe_page * ps + positions % ps, -1)  # [N]
 
     stacked_keys = ("attn_norm", "mlp_norm", "wq", "wk", "wv", "wo") + (
+        ("q_norm", "k_norm") if cfg.qk_norm else ()
+    ) + (("bq", "bk", "bv", "bo") if cfg.attn_bias else ()) + (
         ("router", "moe_wi", "moe_wo") + (("shared_wi", "shared_wo") if cfg.moe_num_shared_experts else ())
         if cfg.is_moe
         else ("wi", "wo_mlp")
@@ -407,6 +426,8 @@ def forward_core(
         q = jnp.einsum("nd,dhk->nhk", h, lp["wq"])
         k = jnp.einsum("nd,dhk->nhk", h, lp["wk"])
         v = jnp.einsum("nd,dhk->nhk", h, lp["wv"])
+        if cfg.attn_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         if has_lora:
             from llmd_tpu.models.lora import apply_lora
 
@@ -417,6 +438,12 @@ def forward_core(
                                lora_scale).reshape(N, Hkn, Dh)
             v = v + apply_lora(h, lp["lora_A_wv"], lp["lora_B_wv"], lora_indices,
                                lora_scale).reshape(N, Hkn, Dh)
+        if cfg.qk_norm:
+            # Per-head RMSNorm over head_dim before RoPE (Qwen3 semantics) — on
+            # the FULL projection output incl. bias and LoRA delta, matching the
+            # HF/PEFT order (adapters are trained against normalised q/k).
+            q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         # this layer's slice of the pool: slots/pages shifted by the layer offset
@@ -430,6 +457,8 @@ def forward_core(
         )
         attn = attn[..., :Dh]
         o = jnp.einsum("nhk,hkd->nd", attn, lp["wo"])
+        if cfg.attn_bias:
+            o = o + lp["bo"]
         if has_lora:
             attn_flat = attn.reshape(N, cfg.num_heads * Dh)
             o = o + apply_lora(attn_flat, lp["lora_A_wo"], lp["lora_B_wo"],
