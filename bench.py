@@ -112,6 +112,19 @@ def main() -> None:
     eng_cfg.num_pages = max(eng_cfg.num_pages, n_req * pages_per_seq + 64)
     eng_cfg.max_model_len = max(eng_cfg.max_model_len, isl + osl + eng_cfg.decode_steps + 1)
 
+    # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
+    # the latency the pipelined decode path exists to hide
+    import jax.numpy as jnp
+    import numpy as _np
+
+    _f = jax.jit(lambda x: x + 1)
+    _np.asarray(_f(jnp.zeros(())))
+    t0 = time.monotonic()
+    for _ in range(3):
+        _np.asarray(_f(jnp.zeros(())))
+    rtt_ms = (time.monotonic() - t0) / 3 * 1e3
+    print(f"# host<->device RTT {rtt_ms:.1f} ms", file=sys.stderr)
+
     t0 = time.monotonic()
     cfg, params = resolve_model(model)
     weights_src = f"hf:{model}" if params is not None else f"random:{model}"
@@ -210,6 +223,8 @@ def main() -> None:
         "decode_calls": st.n_decode_calls,
         "device_ms_per_decode_call": round(dev_ms_per_decode, 2),
         "host_pack_us_per_call": round(pack_us_per_call, 1),
+        "host_device_rtt_ms": round(rtt_ms, 1),
+        "pipeline_decode": eng_cfg.pipeline_decode,
         "batch": eng_cfg.max_batch_size,
         "decode_steps_fused": eng_cfg.decode_steps,
         "isl": isl,

@@ -27,6 +27,8 @@ class AsyncLLMEngine:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return  # shared across rank frontends — only one step loop
         self._thread = threading.Thread(target=self._run, name="engine-loop", daemon=True)
         self._thread.start()
 
@@ -58,13 +60,15 @@ class AsyncLLMEngine:
         token_ids: list[int],
         sampling: SamplingParams,
         lora_id: Optional[str] = None,
+        rank: int = 0,
     ) -> AsyncIterator[EngineOutput]:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
         self._streams[request_id] = (loop, q)
         try:
             with self._lock:
-                self.engine.add_request(request_id, token_ids, sampling, lora_id)
+                self.engine.add_request(request_id, token_ids, sampling, lora_id,
+                                        rank=rank)
         except ValueError:
             self._streams.pop(request_id, None)
             raise

@@ -26,11 +26,22 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     # Parallelism
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    # DP rank schedulers sharing THIS engine's single SPMD program (wide-EP: each
+    # rank is a router-visible endpoint with its own queue/batch-slot-range/page
+    # partition, while MoE layers share one all-to-all across mesh.dp × mesh.ep —
+    # the reference's --data-parallel-size rank engines, composed the XLA way).
+    # Requires max_batch_size and num_pages divisible by dp_ranks; offload tiers
+    # are per-rank state and are not yet supported with dp_ranks > 1.
+    dp_ranks: int = 1
     # Scheduling
     max_queue: int = 1024
     # Multi-step decode: run N decode iterations in one on-device lax.scan (one host
     # round-trip per N tokens). Stop/max_tokens handled post-hoc by truncation.
     decode_steps: int = 1
+    # Pipelined decode dispatch (async output processing): launch call N+1 chained
+    # on call N's device-resident sampled tokens, read N's results while N+1 runs —
+    # hides the device→host round-trip that otherwise serializes every call.
+    pipeline_decode: bool = True
     # KV offload tier (pages of CPU-side cache; 0 = disabled) — K3 equivalent
     # (TPU_OFFLOAD_NUM_CPU_CHUNKS / STAGING_BLOCKS knobs of the reference connector).
     cpu_offload_pages: int = 0

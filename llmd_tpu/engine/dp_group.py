@@ -334,6 +334,77 @@ class DPLocalBalancer:
             await self._session.close()
 
 
+class WideEPEngineGroup:
+    """DP rank engines sharing ONE SPMD program over a (dp, sp, ep, tp) mesh —
+    the wide-EP topology of the reference (`wide-ep-lws decode.yaml:85-121`),
+    composed the XLA way.
+
+    The reference runs R vLLM rank engines whose MoE layers meet in a DeepEP
+    all-to-all; here the R ranks are scheduler frontends over one jitted step:
+    each rank owns a router-visible HTTP port (InferencePool targetPorts — one
+    endpoint per ``podIP:port``), its own request queue, batch-slot range and KV
+    page partition, while the step program's token axis is sharded over ``dp``
+    and the MoE expert dim over ``ep`` — GSPMD lowers the dispatch/combine
+    einsums to one all-to-all spanning dp×ep, i.e. ALL ranks' devices, exactly
+    the shared fabric collective of the reference topology. Wave lockstep is
+    inherent: one step program serves every rank, so an idle rank simply
+    contributes no rows (vLLM's DP wave semantics without an RPC plane; the
+    cross-host RPC version remains `DPCoordinator`/`DPEngineGroup`).
+
+    Current dryrun simplification (documented, not hidden): the KV page pool is
+    replicated over dp — a production layout shards it by reordering the pool
+    page-major so each rank's partition is a contiguous device-local block.
+    """
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        ranks: Optional[int] = None,
+        model_name: str = "llmd-tpu/model",
+        host: str = "127.0.0.1",
+        port_base: int = 0,
+        tokenizer=None,
+        params=None,
+    ) -> None:
+        from llmd_tpu.engine.async_engine import AsyncLLMEngine
+
+        self.ranks = ranks if ranks is not None else max(1, engine_cfg.mesh.dp)
+        if engine_cfg.dp_ranks == 1 and self.ranks > 1:
+            from dataclasses import replace as _replace
+
+            engine_cfg = _replace(engine_cfg, dp_ranks=self.ranks)
+        if engine_cfg.dp_ranks != self.ranks:
+            raise ValueError(f"dp_ranks={engine_cfg.dp_ranks} != ranks={self.ranks}")
+        if self.ranks > MAX_TARGET_PORTS:
+            raise ValueError(
+                f"{self.ranks} rank ports exceed InferencePool's "
+                f"{MAX_TARGET_PORTS}-port limit")
+        self.engine = LLMEngine(model_cfg, engine_cfg, params=params)
+        self.async_engine = AsyncLLMEngine(self.engine)
+        self.servers: list[EngineServer] = []
+        for r in range(self.ranks):
+            srv = EngineServer(
+                model_cfg, engine_cfg, model_name=model_name, host=host,
+                port=port_base + r if port_base else 0, tokenizer=tokenizer,
+                engine=self.engine, async_engine=self.async_engine, rank=r,
+            )
+            self.servers.append(srv)
+
+    async def start(self) -> None:
+        for srv in self.servers:
+            await srv.start()
+
+    async def stop(self) -> None:
+        self.async_engine.stop()
+        for srv in self.servers:
+            await srv.stop()
+
+    def endpoints(self) -> list[str]:
+        """One router-visible address per DP rank (EPP routes to every rank port)."""
+        return [s.address for s in self.servers]
+
+
 class DPEngineGroup:
     """dp_size_local engine servers + coordinator (on the leader) + optional LB."""
 

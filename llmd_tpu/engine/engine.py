@@ -97,11 +97,31 @@ class LLMEngine:
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.mesh = build_mesh(engine_cfg.mesh) if engine_cfg.mesh.num_devices > 1 else None
-        self.alloc = PageAllocator(
-            engine_cfg.num_pages, engine_cfg.page_size,
-            enable_prefix_caching=engine_cfg.enable_prefix_caching,
-            event_sink=event_sink,
-        )
+        R = max(1, engine_cfg.dp_ranks)
+        self.num_ranks = R
+        if R > 1:
+            if engine_cfg.max_batch_size % R or engine_cfg.num_pages % R:
+                raise ValueError(
+                    f"max_batch_size ({engine_cfg.max_batch_size}) and num_pages "
+                    f"({engine_cfg.num_pages}) must divide dp_ranks={R}")
+            if engine_cfg.cpu_offload_pages > 0 or engine_cfg.offload_fs_path:
+                raise ValueError("KV offload tiers are per-rank state; not yet "
+                                 "supported with dp_ranks > 1")
+            if engine_cfg.batched_tokens // R < 1:
+                raise ValueError(
+                    f"batched_tokens ({engine_cfg.batched_tokens}) must be at "
+                    f"least dp_ranks={R} (each rank needs a token budget)")
+        ppr = engine_cfg.num_pages // R
+        self.allocs = [
+            PageAllocator(
+                ppr, engine_cfg.page_size,
+                enable_prefix_caching=engine_cfg.enable_prefix_caching,
+                event_sink=event_sink, base_id=r * ppr,
+            )
+            for r in range(R)
+        ]
+        self.alloc = self.allocs[0]
+        self.slots_per_rank = engine_cfg.max_batch_size // R
         self.offload = None
         if engine_cfg.cpu_offload_pages > 0 or engine_cfg.offload_fs_path:
             from llmd_tpu.kv.fs_backend import FSKVBackend
@@ -115,12 +135,14 @@ class LLMEngine:
                 pages_per_layer=engine_cfg.num_pages,
             )
             self.alloc.evict_hook = lambda h, pid: self.offload.on_evict(self.cache, h, pid)
-        self.waiting: deque[Sequence] = deque()
+        self.waitq: list[deque[Sequence]] = [deque() for _ in range(R)]
+        self.waiting = self.waitq[0]  # rank-0 alias (single-rank compat)
         self.running: list[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
         self.seqs: dict[str, Sequence] = {}
         self.stats = EngineStats()
         self._key = jax.random.PRNGKey(seed)
         self._outputs: list[EngineOutput] = []
+        self._pending_decode: Optional[dict] = None  # in-flight pipelined call
 
         if params is None:
             params = init_params(model_cfg, jax.random.PRNGKey(seed))
@@ -204,9 +226,16 @@ class LLMEngine:
             return logits, cache, cnt
 
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
-                          temp, top_k, top_p, key, active_mask, lora_idx):
+                          temp, top_k, top_p, key, steps_left, lora_idx):
             """k decode iterations fused on-device (lax.scan): feed sampled token back
-            each step; one host round-trip per k tokens instead of per token."""
+            each step; one host round-trip per k tokens instead of per token.
+
+            ``steps_left [B]`` caps each row device-side (0 = idle slot): rows
+            freeze once their per-row budget (max_tokens / max_model_len
+            remaining) is spent, so a fused call may safely overrun a sequence's
+            end — required by the pipelined dispatch path, where the host reads
+            results one call behind.
+            """
             tokens = _bind(tokens, "dp")
             positions = _bind(positions, "dp")
             page_tables = _bind(page_tables, "dp", None)
@@ -215,7 +244,7 @@ class LLMEngine:
             cu = jnp.arange(B + 1, dtype=jnp.int32)
             ns = jnp.array([B], jnp.int32)
 
-            def body(carry, _):
+            def body(carry, i):
                 cache, toks, pos, lens, key = carry
                 hidden, cache, cnt = forward_core(
                     cfg, params, cache, toks, pos, seq_slots, page_tables, lens,
@@ -227,15 +256,18 @@ class LLMEngine:
                 logits = unembed(cfg, params, hidden)  # [B, vocab]
                 key, sub = jax.random.split(key)
                 nxt = sample_tokens(logits, sub, temp, top_k, top_p)
-                nxt = jnp.where(active_mask, nxt, 0)
-                pos = jnp.where(active_mask, pos + 1, pos)
-                lens = jnp.where(active_mask, lens + 1, lens)
+                act = i < steps_left
+                nxt = jnp.where(act, nxt, 0)
+                pos = jnp.where(act, pos + 1, pos)
+                lens = jnp.where(act, lens + 1, lens)
                 return (cache, nxt, pos, lens, key), (nxt, cnt)
 
-            (cache, _, _, _, _), (toks_out, cnts) = jax.lax.scan(
-                body, (cache, tokens, positions, kv_lens, key), None, length=k_steps,
+            (cache, last_toks, _, _, _), (toks_out, cnts) = jax.lax.scan(
+                body, (cache, tokens, positions, kv_lens, key),
+                jnp.arange(k_steps, dtype=jnp.int32),
             )
-            return toks_out, cache, cnts.sum(0)  # [k, B], cache, [L, E]
+            # last_toks: device-resident chain point for the next pipelined call
+            return toks_out, last_toks, cache, cnts.sum(0)
 
         def _embed(params, cache, tokens, positions, page_tables, kv_lens,
                    cu_q_lens, lora_idx):
@@ -418,10 +450,12 @@ class LLMEngine:
         return self._lora_keys.get(name, name)
 
     def _lora_forget(self, name: str) -> None:
-        """Retire a name's KV: reclaim HBM pages now; the dropped generation key
-        guarantees tiered copies (CPU/FS) never match again."""
+        """Retire a name's KV: reclaim HBM pages now (from every rank's
+        partition); the dropped generation key guarantees tiered copies (CPU/FS)
+        never match again."""
         self._lora_keys.pop(name, None)
-        self.alloc.purge_lora(name)
+        for alloc in self.allocs:
+            alloc.purge_lora(name)
 
     def load_lora_adapter(self, name: str, weights: Optional[dict] = None,
                           seed: Optional[int] = None) -> int:
@@ -502,17 +536,20 @@ class LLMEngine:
         token_ids: list[int],
         sampling: Optional[SamplingParams] = None,
         lora_id: Optional[str] = None,
+        rank: int = 0,
     ) -> None:
         sampling = sampling or SamplingParams()
         if not token_ids:
             raise ValueError("empty prompt")
+        if not (0 <= rank < self.num_ranks):
+            raise ValueError(f"rank {rank} out of range (dp_ranks={self.num_ranks})")
         if len(token_ids) >= self.cfg.max_model_len:
             token_ids = token_ids[: self.cfg.max_model_len - 1]
         ps = self.cfg.page_size
-        if (len(token_ids) + 1 + ps - 1) // ps > self.cfg.num_pages:
+        if (len(token_ids) + 1 + ps - 1) // ps > self.allocs[rank].num_pages:
             raise ValueError(
-                f"prompt needs more KV pages than the whole pool "
-                f"({len(token_ids)} tokens, {self.cfg.num_pages} pages × {ps})"
+                f"prompt needs more KV pages than the rank's pool "
+                f"({len(token_ids)} tokens, {self.allocs[rank].num_pages} pages × {ps})"
             )
         if lora_id and self.lora_registry is not None and not self.lora_registry.has(lora_id):
             # vLLM returns 404 for unknown adapters; silently serving base
@@ -522,9 +559,10 @@ class LLMEngine:
             request_id=request_id, token_ids=list(token_ids), prompt_len=len(token_ids),
             max_tokens=sampling.max_tokens, sampling=sampling, lora_id=lora_id,
             lora_key=self._lora_hash_key(lora_id), arrival_time=time.monotonic(),
+            rank=rank,
         )
         self.seqs[request_id] = seq
-        self.waiting.append(seq)
+        self.waitq[rank].append(seq)
         if self.lora_registry is not None:
             self.lora_registry.on_waiting(lora_id)
 
@@ -541,34 +579,46 @@ class LLMEngine:
             if self.lora_registry.waiting.get(seq.lora_id, 0) > 0:
                 self.lora_registry.waiting[seq.lora_id] -= 1
         try:
-            self.waiting.remove(seq)
+            self.waitq[seq.rank].remove(seq)
         except ValueError:
             pass
         self._free_seq(seq)
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(s is not None for s in self.running)
+        return (any(self.waitq) or any(s is not None for s in self.running)
+                or self._pending_decode is not None)
 
     # ------------------------------------------------------- scheduling core
     def _free_seq(self, seq: Sequence) -> None:
+        alloc = self.allocs[seq.rank]
         for pid in seq.pages:
-            self.alloc.release(pid)
+            alloc.release(pid)
         seq.pages = []
 
     def _try_admit(self) -> None:
-        """Move waiting → running while slots + pages allow; reuse cached prefixes."""
-        while self.waiting:
-            try:
-                slot = self.running.index(None)
-            except ValueError:
+        """Move waiting → running while slots + pages allow; reuse cached prefixes.
+
+        Each DP rank admits independently (own queue, own batch-slot range, own
+        page partition) — a saturated rank never head-of-line-blocks another."""
+        for rank in range(self.num_ranks):
+            self._try_admit_rank(rank)
+
+    def _try_admit_rank(self, rank: int) -> None:
+        waiting = self.waitq[rank]
+        alloc = self.allocs[rank]
+        lo = rank * self.slots_per_rank
+        hi = lo + self.slots_per_rank
+        while waiting:
+            slot = next((i for i in range(lo, hi) if self.running[i] is None), None)
+            if slot is None:
                 return
-            seq = self.waiting[0]
+            seq = waiting[0]
             ps = self.cfg.page_size
             # prefix-cache lookup over complete prompt blocks
             from llmd_tpu.core.kv_events import block_keys_for_tokens
 
             keys = block_keys_for_tokens(seq.token_ids[: seq.prompt_len], ps, seq.lora_key)
-            hit_pages = self.alloc.match_prefix(keys) if self.cfg.enable_prefix_caching else []
+            hit_pages = alloc.match_prefix(keys) if self.cfg.enable_prefix_caching else []
             # never reuse the whole prompt — the final token's logits must be computed
             max_reuse = max(0, (seq.prompt_len - 1) // ps)
             hit_pages = hit_pages[:max_reuse]
@@ -583,12 +633,12 @@ class LLMEngine:
             # pages or a request can consume the pool with its own hits and livelock.
             hits_in_lru = sum(
                 1 for pid in hit_pages
-                if (info := self.alloc.pages.get(pid)) is not None and info.refs == 0
+                if (info := alloc.pages.get(pid)) is not None and info.refs == 0
             )
-            if need_new > self.cfg.num_pages:
+            if need_new > alloc.num_pages:
                 # can never fit (prompt + generated tokens outgrew the pool, e.g. after
                 # a preemption late in generation): finish with length, don't starve
-                self.waiting.popleft()
+                waiting.popleft()
                 seq.finished = True
                 seq.finish_reason = "length"
                 self.seqs.pop(seq.request_id, None)
@@ -597,10 +647,10 @@ class LLMEngine:
                     finish_reason="length", prompt_len=seq.prompt_len,
                 ))
                 continue
-            if self.alloc.num_free - hits_in_lru < need_new:
-                return  # head-of-line blocks; FCFS admission
+            if alloc.num_free - hits_in_lru < need_new:
+                return  # head-of-line blocks; FCFS admission (within this rank)
             for pid in hit_pages:
-                self.alloc.acquire_cached(pid)
+                alloc.acquire_cached(pid)
             n_hbm = len(hit_pages)
             off_pages = self._reload_offloaded(seq, keys, n_hbm, n_offload)
             seq.pages = list(hit_pages) + off_pages
@@ -609,7 +659,7 @@ class LLMEngine:
             seq.num_cached_prompt = seq.num_computed
             seq.slot = slot
             self.running[slot] = seq
-            self.waiting.popleft()
+            waiting.popleft()
             if self.lora_registry is not None:
                 self.lora_registry.on_running(seq.lora_id)
 
@@ -645,16 +695,19 @@ class LLMEngine:
     def _ensure_pages(self, seq: Sequence, upto_tokens: int) -> bool:
         ps = self.cfg.page_size
         need = (upto_tokens + ps - 1) // ps
+        alloc = self.allocs[seq.rank]
         while len(seq.pages) < need:
-            pid = self.alloc.allocate()
+            pid = alloc.allocate()
             if pid is None:
                 return False
             seq.pages.append(pid)
         return True
 
-    def _preempt_one(self) -> bool:
-        """Evict the most recently arrived running seq back to waiting (recompute)."""
-        victims = [s for s in self.running if s is not None]
+    def _preempt_one(self, rank: int = 0) -> bool:
+        """Evict the rank's most recently arrived running seq back to waiting
+        (recompute semantics). Pages are rank-partitioned, so only a same-rank
+        victim frees memory the caller can use."""
+        victims = [s for s in self.running if s is not None and s.rank == rank]
         if not victims:
             return False
         victim = max(victims, key=lambda s: s.arrival_time)
@@ -667,7 +720,7 @@ class LLMEngine:
         victim.num_computed = 0
         victim.block_hashes = []
         victim.num_cached_prompt = 0
-        self.waiting.appendleft(victim)
+        self.waitq[rank].appendleft(victim)
         self.stats.total_preemptions += 1
         return True
 
@@ -680,12 +733,15 @@ class LLMEngine:
             self._offload_drain()
         self._try_admit()
         if self._prefilling_seqs():
+            # the mixed step reads host token state — apply any in-flight decode first
+            self._flush_pending_decode()
             self._step_unified()
         else:
             self._step_decode()
-        self.stats.num_waiting = len(self.waiting)
+        self.stats.num_waiting = sum(len(q) for q in self.waitq)
         self.stats.num_running = sum(1 for s in self.running if s is not None)
-        self.stats.kv_utilization = self.alloc.utilization()
+        self.stats.kv_utilization = (
+            sum(a.num_active for a in self.allocs) / max(1, self.cfg.num_pages))
         if self._eplb is not None:
             self._eplb_tick()
         return self._outputs
@@ -732,36 +788,42 @@ class LLMEngine:
         t0 = time.perf_counter()
         NT = self.cfg.batched_tokens
         B = self.cfg.max_batch_size
-        budget = NT
+        R = self.num_ranks
+        # per-rank token budgets (the reference's per-rank-engine
+        # --max-num-batched-tokens); single-rank engines keep the whole budget
+        budgets = [NT // R] * R
 
         # decode rows first (keeps TPOT low while prompts stream in), then
         # prefill chunks oldest-first
         plan: list[tuple[Sequence, int, bool]] = []  # (seq, q_len, is_decode)
         for s in self._decode_ready():
-            if budget <= 0 or len(plan) >= B:
+            if len(plan) >= B:
                 break
+            if budgets[s.rank] <= 0:
+                continue
             if not self._ensure_pages(s, len(s.token_ids)):
-                if not self._preempt_one() or s.slot < 0:
+                if not self._preempt_one(s.rank) or s.slot < 0:
                     continue
                 if not self._ensure_pages(s, len(s.token_ids)):
                     continue
             plan.append((s, 1, True))
-            budget -= 1
+            budgets[s.rank] -= 1
         for s in self._prefilling_seqs():
-            if budget <= 0 or len(plan) >= B:
+            if len(plan) >= B:
                 break
             if s.slot < 0:
                 continue  # preempted while packing decode rows
-            n = min(self.cfg.prefill_chunk, self._prefill_target(s) - s.num_computed, budget)
+            n = min(self.cfg.prefill_chunk, self._prefill_target(s) - s.num_computed,
+                    budgets[s.rank])
             if n <= 0:
                 continue
             if not self._ensure_pages(s, s.num_computed + n):
-                if not self._preempt_one() or s.slot < 0:
+                if not self._preempt_one(s.rank) or s.slot < 0:
                     continue
                 if not self._ensure_pages(s, s.num_computed + n):
                     continue
             plan.append((s, n, False))
-            budget -= n
+            budgets[s.rank] -= n
         plan = [(s, n, d) for (s, n, d) in plan if s.slot >= 0]
         if not plan:
             return
@@ -802,12 +864,12 @@ class LLMEngine:
         for i, (s, n, is_decode) in enumerate(plan):
             if is_decode:
                 s.num_computed = len(s.token_ids)
-                s.maybe_commit_blocks(self.alloc)
+                s.maybe_commit_blocks(self.allocs[s.rank])
                 self.stats.total_decode_tokens += 1
                 sample_list.append((i, s))
             else:
                 s.num_computed += n
-                s.maybe_commit_blocks(self.alloc)
+                s.maybe_commit_blocks(self.allocs[s.rank])
                 self.stats.total_prefill_tokens += n
                 if (len(s.token_ids) == s.prompt_len
                         and s.num_computed == s.prompt_len):
@@ -824,80 +886,121 @@ class LLMEngine:
         st.n_unified_steps += 1
 
     def _step_decode(self) -> None:
+        """Fused multi-step decode with pipelined dispatch.
+
+        The tunnel/PCIe round-trip for reading sampled tokens is the dominant
+        serving overhead off-device (measured ~69 ms through the dev tunnel, and
+        real on any host): with ``cfg.pipeline_decode`` the host dispatches call
+        N+1 chained on call N's *device-resident* last tokens, then reads call
+        N's results while N+1 runs — vLLM's async output processing, XLA-style.
+        The chain holds only while the active set is unchanged; any membership
+        change (finish, preemption, new prefill) flushes first.
+        """
         t0 = time.perf_counter()
         active = self._decode_ready()
         if not active:
+            self._flush_pending_decode()
             return
         B = self.cfg.max_batch_size
         k = max(1, self.cfg.decode_steps)
-        # A k-step scan writes KV for positions len-1 .. len+k-2 → needs len+k-1 slots.
-        # If the pool can't cover the full horizon, degrade to a single unified step
-        # (decode rows only) rather than preempting sequences that could progress.
-        if k > 1:
-            ok = all(
-                self._ensure_pages(s, min(len(s.token_ids) + k - 1, self.cfg.max_model_len))
-                for s in active if s.slot >= 0
-            )
-            if not ok:
-                self._step_unified()
-                return
-        else:
-            for s in list(active):
-                if s.slot < 0:
-                    continue
-                while not self._ensure_pages(s, len(s.token_ids)):
-                    if not self._preempt_one() or s.slot < 0:
-                        break
-        active = [
-            s for s in active
-            if s.slot >= 0 and len(s.pages) * self.cfg.page_size
-            >= min(len(s.token_ids) + k - 1, self.cfg.max_model_len)
-        ]
+        pend = self._pending_decode
+        off = pend["k"] if pend is not None else 0
+
+        # A k-step scan writes KV for positions len-1 .. len+off+k-2 → needs
+        # len+off+k-1 slots. If the pool can't cover the horizon, flush and
+        # degrade to a single unified step (decode rows only) rather than
+        # preempting sequences that could progress.
+        ok = all(
+            self._ensure_pages(
+                s, min(len(s.token_ids) + off + k - 1, self.cfg.max_model_len))
+            for s in active if s.slot >= 0
+        )
+        if not ok:
+            self._flush_pending_decode()
+            self._step_unified()
+            return
+        active = [s for s in active if s.slot >= 0]
         if not active:
             return
 
-        toks = np.zeros((B,), np.int32)
+        if pend is not None:
+            same = {(s.request_id, s.slot) for s in active} == {
+                (s.request_id, slot) for s, slot in pend["rows"]}
+            if same and self.cfg.pipeline_decode:
+                rec = self._decode_dispatch(active, k, chain=pend, wall_start=t0)
+                self._decode_process(pend)
+                self._pending_decode = rec
+                return
+            self._flush_pending_decode()
+            active = [s for s in self._decode_ready() if s.slot >= 0]
+            if not active:
+                return
+        rec = self._decode_dispatch(active, k, chain=None, wall_start=t0)
+        if self.cfg.pipeline_decode:
+            self._pending_decode = rec
+        else:
+            self._decode_process(rec)
+
+    def _flush_pending_decode(self) -> None:
+        pend, self._pending_decode = self._pending_decode, None
+        if pend is not None:
+            self._decode_process(pend)
+
+    def _decode_dispatch(self, active: list[Sequence], k: int, chain: Optional[dict],
+                         wall_start: float) -> dict:
+        """Pack host state (+ a pending call's un-processed offset) and launch one
+        fused k-step decode. Returns the in-flight record; results are NOT read."""
+        B = self.cfg.max_batch_size
+        off = chain["k"] if chain is not None else 0
         pos = np.full((B,), -1, np.int32)
         pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
         lens = np.ones((B,), np.int32)
         lora_idx = np.zeros((B,), np.int32)
-        for s in active:
-            i = s.slot
-            toks[i] = s.token_ids[-1]
-            pos[i] = len(s.token_ids) - 1
-            pts[i, : len(s.pages)] = s.pages
-            lens[i] = len(s.token_ids)
-            lora_idx[i] = self._lora_slot(s)
-        self._step_decode_multi(active, toks, pos, pts, lens, lora_idx, k, wall_start=t0)
-
-    def _step_decode_multi(self, active, toks, pos, pts, lens, lora_idx, k: int,
-                           wall_start: Optional[float] = None) -> None:
-        if wall_start is None:
-            wall_start = time.perf_counter()
-        B = self.cfg.max_batch_size
+        steps_left = np.zeros((B,), np.int32)
         temp = np.zeros((B,), np.float32)
         tk = np.zeros((B,), np.int32)
         tp = np.ones((B,), np.float32)
-        mask = np.zeros((B,), bool)
+        toks = np.zeros((B,), np.int32)
         for s in active:
+            i = s.slot
+            eff_len = len(s.token_ids) + off  # host view + in-flight tokens
+            toks[i] = s.token_ids[-1]  # unused when chaining (device tokens win)
+            pos[i] = eff_len - 1
+            pts[i, : len(s.pages)] = s.pages
+            lens[i] = eff_len
+            lora_idx[i] = self._lora_slot(s)
             sp: SamplingParams = s.sampling
-            temp[s.slot], tk[s.slot], tp[s.slot] = sp.temperature, sp.top_k, sp.top_p
-            mask[s.slot] = True
+            temp[i], tk[i], tp[i] = sp.temperature, sp.top_k, sp.top_p
+            gen = eff_len - s.prompt_len
+            steps_left[i] = max(0, min(s.max_tokens - gen,
+                                       self.cfg.max_model_len - eff_len, k))
         self._key, sub = jax.random.split(self._key)
+        toks_in = chain["last_toks"] if chain is not None else jnp.asarray(toks)
         t1 = time.perf_counter()
         self.stats.time_host_pack += t1 - wall_start
-        toks_out, self.cache, cnt = self._decode_multi_fn(
-            self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
+        toks_out, last_toks, self.cache, cnt = self._decode_multi_fn(
+            self._run_params(), self.cache, toks_in, jnp.asarray(pos),
             jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(tk),
-            jnp.asarray(tp), sub, jnp.asarray(mask), jnp.asarray(lora_idx),
+            jnp.asarray(tp), sub, jnp.asarray(steps_left), jnp.asarray(lora_idx),
         )
+        self.stats.time_decode_steps += time.perf_counter() - wall_start
+        return {
+            "rows": [(s, s.slot) for s in active],
+            "toks_out": toks_out, "last_toks": last_toks, "cnt": cnt, "k": k,
+        }
+
+    def _decode_process(self, rec: dict) -> None:
+        """Read one in-flight decode call's results and apply them to host state."""
+        t1 = time.perf_counter()
         if self._eplb is not None:
-            self._eplb_record(cnt)
-        toks_out = np.asarray(toks_out)  # [k, B] (device sync point)
+            self._eplb_record(rec["cnt"])
+        toks_out = np.asarray(rec["toks_out"])  # [k, B] (device sync point)
         t2 = time.perf_counter()
         now = time.monotonic()
-        for s in active:
-            new = [int(t) for t in toks_out[:, s.slot]]
+        for s, slot in rec["rows"]:
+            if s.finished or s.slot != slot or self.running[slot] is not s:
+                continue  # aborted / preempted / replaced while in flight
+            new = [int(t) for t in toks_out[:, slot]]
             kept: list[int] = []
             finished, reason = False, None
             for t in new:
@@ -910,7 +1013,7 @@ class LLMEngine:
             s.num_computed = len(s.token_ids) - 1
             if s.first_token_time is None:
                 s.first_token_time = now
-            s.maybe_commit_blocks(self.alloc)
+            s.maybe_commit_blocks(self.allocs[s.rank])
             self.stats.total_decode_tokens += len(kept)
             if finished:
                 self._retire(s, reason)
@@ -924,7 +1027,7 @@ class LLMEngine:
         st.time_device += t2 - t1
         st.time_device_decode += t2 - t1
         st.time_postprocess += t3 - t2
-        st.time_decode_steps += t3 - wall_start
+        st.time_decode_steps += t3 - t1
         st.n_decode_calls += 1
 
     def _retire(self, seq: Sequence, reason: Optional[str]) -> None:
@@ -981,12 +1084,14 @@ class LLMEngine:
         return False, None
 
     # ------------------------------------------------------------- embeddings
-    def embed(self, token_ids: list[int], lora_id: Optional[str] = None) -> list[float]:
+    def embed(self, token_ids: list[int], lora_id: Optional[str] = None,
+              rank: int = 0) -> list[float]:
         """Mean-pooled, L2-normalised final hidden state (/v1/embeddings path).
 
         Runs chunk-wise through the compiled embed program (flat single-sequence
-        batches), borrowing KV pages only for the duration of the call. The
-        caller serialises against the step loop (run_locked in the server).
+        batches), borrowing KV pages from the requesting rank's partition only
+        for the duration of the call. The caller serialises against the step
+        loop (run_locked in the server).
         """
         if not token_ids:
             raise ValueError("empty input")
@@ -994,12 +1099,13 @@ class LLMEngine:
         chunk = self.cfg.prefill_chunk
         ps = self.cfg.page_size
         need = (len(token_ids) + ps - 1) // ps
+        alloc = self.allocs[rank if 0 <= rank < self.num_ranks else 0]
         pages: list[int] = []
         for _ in range(need):
-            pid = self.alloc.allocate()
+            pid = alloc.allocate()
             if pid is None:
                 for p in pages:
-                    self.alloc.release(p)
+                    alloc.release(p)
                 raise RuntimeError("no free KV pages for embedding request")
             pages.append(pid)
         try:
@@ -1025,7 +1131,7 @@ class LLMEngine:
                 acc += np.asarray(h_sum, np.float64)
         finally:
             for p in pages:
-                self.alloc.release(p)
+                alloc.release(p)
         vec = acc / max(1, len(token_ids))
         norm = float(np.linalg.norm(vec))
         return (vec / norm if norm > 0 else vec).astype(float).tolist()

@@ -45,6 +45,7 @@ class PageAllocator:
         enable_prefix_caching: bool = True,
         event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
         medium: str = "gpu",
+        base_id: int = 0,
     ) -> None:
         self.num_pages = num_pages
         self.page_size = page_size
@@ -54,7 +55,10 @@ class PageAllocator:
         # Called (block_hash, page_id) just before a cached page is recycled —
         # the offload connector's HBM→CPU hook (kv/offload.py).
         self.evict_hook: Optional[Callable[[int, int], None]] = None
-        self.free: deque[int] = deque(range(num_pages))
+        # base_id: first page id owned by this allocator — DP rank engines sharing
+        # one device pool each manage a disjoint contiguous id range (wide-EP).
+        self.base_id = base_id
+        self.free: deque[int] = deque(range(base_id, base_id + num_pages))
         self.pages: dict[int, PageInfo] = {}
         # block_hash → page_id for complete blocks still resident (any refcount)
         self.cached: dict[int, int] = {}
@@ -200,7 +204,7 @@ class PageAllocator:
         return len(removed)
 
     def clear(self) -> None:
-        self.free = deque(range(self.num_pages))
+        self.free = deque(range(self.base_id, self.base_id + self.num_pages))
         self.pages.clear()
         self.cached.clear()
         self.lru.clear()
@@ -231,6 +235,7 @@ class Sequence:
     block_hashes: list[int] = field(default_factory=list)  # chained hashes of committed blocks
     arrival_time: float = 0.0
     first_token_time: Optional[float] = None
+    rank: int = 0  # owning DP rank scheduler (wide-EP; 0 in single-rank engines)
 
     @property
     def num_generated(self) -> int:

@@ -44,6 +44,12 @@ def main() -> None:
     ap.add_argument("--max-loras", type=int, default=8)
     ap.add_argument("--max-lora-rank", type=int, default=8)
     ap.add_argument("--cpu", action="store_true", help="force CPU platform (dev)")
+    ap.add_argument("--data-parallel-size", type=int, default=1, dest="dp",
+                    help="wide-EP DP rank engines sharing one SPMD program; each "
+                         "rank serves on port+rank (reference --data-parallel-size)")
+    ap.add_argument("--expert-parallel-size", type=int, default=1, dest="ep")
+    ap.add_argument("--tensor-parallel-size", type=int, default=1, dest="tp")
+    ap.add_argument("--sequence-parallel-size", type=int, default=1, dest="sp")
     args = ap.parse_args()
 
     if args.cpu:
@@ -60,6 +66,8 @@ def main() -> None:
     from llmd_tpu.engine.tokenizer import load_tokenizer
     from llmd_tpu.models import resolve_model
 
+    from llmd_tpu.parallel.mesh import MeshConfig
+
     model_cfg, params = resolve_model(args.model)
     engine_cfg = EngineConfig(
         page_size=args.block_size, num_pages=args.num_pages,
@@ -67,6 +75,8 @@ def main() -> None:
         prefill_chunk=args.prefill_chunk, decode_steps=args.decode_steps,
         role=args.role, cpu_offload_pages=args.cpu_offload_pages,
         offload_fs_path=args.offload_fs_path,
+        mesh=MeshConfig(dp=args.dp, sp=args.sp, ep=args.ep, tp=args.tp),
+        dp_ranks=args.dp,
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig
@@ -82,6 +92,26 @@ def main() -> None:
             f"could not load an HF tokenizer from {tok_path!r} for real-weight "
             "serving; pass --tokenizer <dir> with tokenizer.json present"
         )
+    if args.dp > 1:
+        from llmd_tpu.engine.dp_group import WideEPEngineGroup
+
+        group = WideEPEngineGroup(
+            model_cfg, engine_cfg,
+            model_name=args.served_model_name or f"llmd-tpu/{model_cfg.name}",
+            host=args.host, port_base=args.port, tokenizer=tokenizer,
+            params=params,
+        )
+
+        async def run_group() -> None:
+            await group.start()
+            print(f"llmd-tpu wide-EP group serving "
+                  f"{args.dp} rank engines on {group.endpoints()} "
+                  f"(mesh dp={args.dp} sp={args.sp} ep={args.ep} tp={args.tp})",
+                  flush=True)
+            await asyncio.Event().wait()
+
+        asyncio.run(run_group())
+        return
     server = EngineServer(
         model_cfg, engine_cfg,
         model_name=args.served_model_name or f"llmd-tpu/{model_cfg.name}",

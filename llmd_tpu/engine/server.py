@@ -66,6 +66,9 @@ class EngineServer:
         kv_transfer_port: Optional[int] = None,
         tokenizer: Optional[Tokenizer] = None,
         params=None,
+        engine: Optional[LLMEngine] = None,
+        async_engine: Optional["AsyncLLMEngine"] = None,
+        rank: int = 0,
     ) -> None:
         self.model_name = model_name
         self.host, self.port = host, port
@@ -82,9 +85,23 @@ class EngineServer:
         self._pending_events: list[KVEvent] = []
         self._ev_lock = __import__("threading").Lock()
 
-        self.engine = LLMEngine(model_cfg, engine_cfg, params=params,
-                                event_sink=self._on_kv_events)
-        self.async_engine = AsyncLLMEngine(self.engine)
+        # Wide-EP rank frontends share one engine + step loop; each server is a
+        # router-visible endpoint feeding its own rank queue (decode.yaml rank
+        # ports semantics). Standalone servers build their own engine.
+        self.rank = rank
+        if engine is not None:
+            if async_engine is None:
+                # two private step loops over one engine would race the scheduler
+                raise ValueError("a shared engine requires the shared async_engine")
+            self.engine = engine
+            self.async_engine = async_engine
+            # this frontend's rank publishes its own KV events
+            if rank < len(engine.allocs):
+                engine.allocs[rank].event_sink = self._on_kv_events
+        else:
+            self.engine = LLMEngine(model_cfg, engine_cfg, params=params,
+                                    event_sink=self._on_kv_events)
+            self.async_engine = AsyncLLMEngine(self.engine)
         self._runner: Optional[web.AppRunner] = None
         self.request_count = 0
         from llmd_tpu.obs.tracing import global_tracer
@@ -249,7 +266,8 @@ class EngineServer:
             )
 
         try:
-            gen = self.async_engine.generate(rid, token_ids, sampling, lora_id)
+            gen = self.async_engine.generate(rid, token_ids, sampling, lora_id,
+                                             rank=self.rank)
             if not stream:
                 out_ids: list[int] = []
                 cached = 0
@@ -368,7 +386,7 @@ class EngineServer:
                 vec = await loop.run_in_executor(
                     None,
                     lambda ids=ids: self.async_engine.run_locked(
-                        lambda: self.engine.embed(ids, lora_id)))
+                        lambda: self.engine.embed(ids, lora_id, rank=self.rank)))
             except RuntimeError as exc:
                 return web.json_response({"error": {"message": str(exc)}}, status=503)
             data.append({"object": "embedding", "index": i, "embedding": vec})

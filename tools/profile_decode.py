@@ -1,0 +1,154 @@
+"""Attribute the fused-decode step cost on the real chip (bench.py directive #3).
+
+Builds the llama-1b decode program at bench shapes and times ablated variants:
+  full        — forward + unembed + sample (what serving runs)
+  no-sample   — forward + unembed + argmax feedback
+  no-unembed  — forward only (constant token feedback)
+  weights-probe — einsums touching the big weights once (HBM roofline probe)
+
+Differences between adjacent variants attribute per-step time to sampling,
+unembed, and the transformer body; the probe bounds achievable HBM bandwidth.
+
+Usage: python tools/profile_decode.py [--batch 32] [--steps 16] [--kvlen 320]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--steps", type=int, default=16)
+    ap.add_argument("--kvlen", type=int, default=320)
+    ap.add_argument("--model", default="llama-1b")
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax._src.xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+    import jax
+    import jax.numpy as jnp
+
+    from llmd_tpu.engine.sampling import sample_tokens
+    from llmd_tpu.models import get_model_config
+    from llmd_tpu.models.transformer import (
+        forward_core,
+        init_cache,
+        init_params,
+        ragged_paged_attention_xla,
+        unembed,
+    )
+
+    cfg = get_model_config(args.model)
+    B, k, kvlen = args.batch, args.steps, args.kvlen
+    ps, num_pages = 16, 2048
+    max_pages = 1024 // ps
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        from llmd_tpu.ops.paged_attention import paged_attention_tpu as attn
+    else:
+        attn = ragged_paged_attention_xla
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks0 = jnp.ones((B,), jnp.int32)
+    pos0 = jnp.full((B,), kvlen - 1, jnp.int32)
+    # disjoint page tables per sequence (row-major page grid)
+    import numpy as np
+
+    pts_np = np.full((B, max_pages), -1, np.int32)
+    need = (kvlen + k + ps - 1) // ps
+    for b in range(B):
+        for j in range(need):
+            pid = b * need + j
+            pts_np[b, j] = pid if pid < num_pages else -1
+    pts = jnp.asarray(pts_np)
+    lens0 = jnp.full((B,), kvlen, jnp.int32)
+    seq_slots = jnp.arange(B, dtype=jnp.int32)
+    cu = jnp.arange(B + 1, dtype=jnp.int32)
+    ns = jnp.array([B], jnp.int32)
+    temp = jnp.zeros((B,), jnp.float32)
+    tk = jnp.zeros((B,), jnp.int32)
+    tp = jnp.ones((B,), jnp.float32)
+    key = jax.random.PRNGKey(1)
+
+    def make_fn(mode):
+        def step(params, carry, _):
+            cache, toks, pos, lens = carry
+            hidden, cache, _ = forward_core(
+                cfg, params, cache, toks, pos, seq_slots, pts, lens,
+                cu_q_lens=cu, num_seqs=ns, attn_impl=attn)
+            if mode == "no-unembed":
+                nxt = toks
+            else:
+                logits = unembed(cfg, params, hidden)
+                if mode == "no-sample":
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                else:
+                    nxt = sample_tokens(logits, key, temp, tk, tp)
+            return (cache, nxt, pos + 1, lens + 1), nxt
+
+        def fn(params, cache, toks, pos, lens):
+            (cache, toks, pos, lens), out = jax.lax.scan(
+                lambda c, x: step(params, c, x), (cache, toks, pos, lens),
+                None, length=k)
+            return out, cache
+
+        return jax.jit(fn, donate_argnums=(1,))
+
+    print(f"# {args.model} B={B} k={k} kvlen={kvlen} "
+          f"attn={'pallas' if on_tpu else 'xla'} on {jax.devices()[0].device_kind}")
+    base = None
+    for mode in ["full", "no-sample", "no-unembed"]:
+        fn = make_fn(mode)
+        cache = init_cache(cfg, num_pages, ps)
+        out, cache = fn(params, cache, toks0, pos0, lens0)  # compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(args.reps):
+            out, cache = fn(params, cache, toks0, pos0, lens0)
+        jax.block_until_ready(out)
+        t = (time.perf_counter() - t0) / args.reps
+        delta = "" if base is None else f"  (delta {(base - t)/k*1e3:+6.2f} ms/step)"
+        if base is None:
+            base = t
+        print(f"{mode:12s}: {t*1e3:8.2f} ms/call  {t/k*1e3:6.2f} ms/step{delta}")
+        del cache
+
+    # HBM roofline probe: decode-like einsums touching each big weight once
+    x = jnp.ones((B, cfg.hidden_size), cfg.jax_dtype)
+
+    @jax.jit
+    def wprobe(p, x):
+        q = jnp.einsum("bd,ldhk->blhk", x, p["wq"])
+        kk = jnp.einsum("bd,ldhk->blhk", x, p["wk"])
+        v = jnp.einsum("bd,ldhk->blhk", x, p["wv"])
+        o = jnp.einsum("blhk,lhkd->bd", q, p["wo"])
+        y = jnp.einsum("bd,ldf->blf", x, p["wi"])
+        z = jnp.einsum("blf,lfd->bd", y[..., : cfg.intermediate_size], p["wo_mlp"])
+        e = jnp.einsum("bd,vd->bv", x, p["embed"])
+        return (z + o).sum() + e.sum() + kk.sum() + v.sum()
+
+    out = wprobe(params, x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        out = wprobe(params, x)
+    jax.block_until_ready(out)
+    t = (time.perf_counter() - t0) / args.reps
+    total = sum(int(v.size) for v in params.values())
+    gb = total * 2 / 1e9
+    print(f"weights-probe: {t*1e3:8.2f} ms for ~{gb:.2f} GB -> {gb/t:.0f} GB/s")
+
+
+if __name__ == "__main__":
+    main()
