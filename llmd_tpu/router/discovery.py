@@ -1,0 +1,270 @@
+"""Endpoint discovery sources feeding the EndpointPool.
+
+Parity: the reference data layer's endpoint sources
+(/root/reference/docs/architecture/core/router/epp/datalayer.md:5-91) —
+``k8s-notification-source`` (GVK watch keyed by the InferencePool selector;
+pods join at status Running, leave on deletion) and the ``file-discovery``
+plugin of no-Kubernetes mode
+(guides/no-kubernetes-deployment/router/epp/config.yaml:10-41). Both implement
+one ``EndpointSource`` interface over the same ``EndpointPool``, so the
+scheduler never knows which discovery mode is running.
+
+``K8sWatchSource`` speaks the plain Kubernetes HTTP API (list + watch with
+resourceVersion resume, bookmark tolerance, backoff re-list) via aiohttp — no
+kubernetes client dependency; in-cluster config comes from the conventional
+service-account mount. The fixture-tested contract lives in
+tests/test_discovery.py against a fake API server.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+from typing import Optional
+
+import aiohttp
+
+log = logging.getLogger("llmd_tpu.discovery")
+
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool, EndpointRole
+from llmd_tpu.router.datalayer import load_endpoints_file
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class EndpointSource:
+    """Discovery source interface: populate/maintain an EndpointPool."""
+
+    def __init__(self, pool: EndpointPool) -> None:
+        self.pool = pool
+
+    async def start(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    async def stop(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FileSource(EndpointSource):
+    """file-discovery with live re-scan: edits to the endpoints file (add /
+    remove lines) apply without a restart (mtime-polled)."""
+
+    def __init__(self, pool: EndpointPool, path: str,
+                 rescan_interval_s: float = 2.0) -> None:
+        super().__init__(pool)
+        self.path = path
+        self.interval = rescan_interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._mtime = 0.0
+        self._known: set[str] = set()
+        self.last_error: Optional[Exception] = None
+
+    def _scan(self) -> None:
+        staging = EndpointPool()
+        load_endpoints_file(staging, self.path)
+        now = {e.address for e in staging.list()}
+        for e in staging.list():
+            self.pool.upsert(e)
+        for gone in self._known - now:
+            self.pool.remove(gone)
+        self._known = now
+
+    async def start(self) -> None:
+        self._scan()
+        self._mtime = os.path.getmtime(self.path)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                m = os.path.getmtime(self.path)
+                if m != self._mtime:
+                    self._mtime = m
+                    self._scan()
+                    self.last_error = None
+            except OSError:
+                pass  # file briefly absent mid-rewrite
+            except Exception as e:  # malformed content must not kill live reload
+                if str(e) != str(self.last_error or ""):
+                    log.warning("endpoints file %s re-scan failed: %s", self.path, e)
+                self.last_error = e
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+def _pod_to_endpoint(pod: dict, port: int) -> Optional[Endpoint]:
+    """Running+ready pod → Endpoint; None when it should not be routed."""
+    status = pod.get("status", {})
+    if status.get("phase") != "Running" or not status.get("podIP"):
+        return None
+    conds = {c.get("type"): c.get("status") for c in status.get("conditions", [])}
+    if conds.get("Ready") != "True":
+        return None
+    labels = pod.get("metadata", {}).get("labels", {})
+    role = labels.get("llm-d.ai/role", "both")
+    try:
+        role_e = EndpointRole(role)
+    except ValueError:
+        role_e = EndpointRole.BOTH
+    return Endpoint(
+        address=f"{status['podIP']}:{port}",
+        name=pod.get("metadata", {}).get("name", ""),
+        role=role_e,
+        labels=labels,
+        engine_type=labels.get("llm-d.ai/engine-type", "llmd-tpu"),
+    )
+
+
+class K8sWatchSource(EndpointSource):
+    """Kubernetes pod watch keyed by the InferencePool's selector.
+
+    list → seed pool (+resourceVersion) → watch stream (ADDED/MODIFIED map to
+    upsert-or-remove on readiness, DELETED removes); 410 Gone / stream end →
+    re-list with backoff. Multi-port pools (DP rank engines,
+    inferencepool.md targetPorts ≤ 8) surface one endpoint per podIP:port.
+    """
+
+    def __init__(
+        self,
+        pool: EndpointPool,
+        selector: dict[str, str],
+        ports: list[int],
+        namespace: str = "default",
+        api_base: Optional[str] = None,
+        token: Optional[str] = None,
+        rebackoff_s: float = 1.0,
+    ) -> None:
+        super().__init__(pool)
+        self.selector = selector
+        self.ports = ports[:8]  # targetPorts limit (inferencepool.md)
+        self.namespace = namespace
+        self.api_base = api_base or self._in_cluster_base()
+        self.token = token if token is not None else self._in_cluster_token()
+        self.backoff = rebackoff_s
+        self._task: Optional[asyncio.Task] = None
+        self._addresses: dict[str, set[str]] = {}  # pod uid → addresses
+        self.relists = 0
+        self.events_seen = 0
+        self.last_error: Optional[Exception] = None
+
+    @staticmethod
+    def _in_cluster_base() -> str:
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        scheme = "https" if port == "443" else "http"
+        return f"{scheme}://{host}:{port}"
+
+    @staticmethod
+    def _in_cluster_token() -> Optional[str]:
+        try:
+            with open(os.path.join(SA_DIR, "token")) as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    @property
+    def _label_selector(self) -> str:
+        return ",".join(f"{k}={v}" for k, v in sorted(self.selector.items()))
+
+    def _headers(self) -> dict[str, str]:
+        return {"Authorization": f"Bearer {self.token}"} if self.token else {}
+
+    def _apply(self, pod: dict, deleted: bool) -> None:
+        uid = pod.get("metadata", {}).get("uid") or pod.get("metadata", {}).get("name", "")
+        old = self._addresses.pop(uid, set())
+        new: set[str] = set()
+        if not deleted:
+            for port in self.ports:
+                ep = _pod_to_endpoint(pod, port)
+                if ep is not None:
+                    self.pool.upsert(ep)
+                    new.add(ep.address)
+        for addr in old - new:
+            self.pool.remove(addr)
+        if new:
+            self._addresses[uid] = new
+
+    async def _list(self, session: aiohttp.ClientSession) -> str:
+        url = (f"{self.api_base}/api/v1/namespaces/{self.namespace}/pods"
+               f"?labelSelector={self._label_selector}")
+        async with session.get(url, headers=self._headers()) as resp:
+            resp.raise_for_status()
+            data = await resp.json()
+        self.relists += 1
+        seen_uids = set()
+        for pod in data.get("items", []):
+            self._apply(pod, deleted=False)
+            seen_uids.add(pod.get("metadata", {}).get("uid", ""))
+        for uid in list(self._addresses):
+            if uid not in seen_uids:
+                self._apply({"metadata": {"uid": uid}}, deleted=True)
+        return data.get("metadata", {}).get("resourceVersion", "")
+
+    async def _watch(self, session: aiohttp.ClientSession, rv: str) -> None:
+        url = (f"{self.api_base}/api/v1/namespaces/{self.namespace}/pods"
+               f"?labelSelector={self._label_selector}&watch=1&resourceVersion={rv}"
+               f"&allowWatchBookmarks=true")
+        async with session.get(
+            url, headers=self._headers(),
+            timeout=aiohttp.ClientTimeout(total=None, sock_read=330),
+        ) as resp:
+            resp.raise_for_status()
+            async for line in resp.content:
+                line = line.strip()
+                if not line:
+                    continue
+                ev = json.loads(line)
+                self.events_seen += 1
+                etype = ev.get("type")
+                if etype == "BOOKMARK":
+                    continue
+                if etype == "ERROR":  # e.g. 410 Gone — caller re-lists
+                    return
+                self._apply(ev.get("object", {}), deleted=etype == "DELETED")
+
+    async def _loop(self) -> None:
+        connector = None
+        if self.api_base.startswith("https") and os.path.isfile(
+                os.path.join(SA_DIR, "ca.crt")):
+            import ssl
+
+            ctx = ssl.create_default_context(cafile=os.path.join(SA_DIR, "ca.crt"))
+            connector = aiohttp.TCPConnector(ssl=ctx)
+        # read_bufsize: watch events are one JSON line per pod object — real pods
+        # routinely exceed aiohttp's 64 KiB default line limit (managedFields)
+        async with aiohttp.ClientSession(connector=connector,
+                                         read_bufsize=4 * 1024 * 1024) as session:
+            while True:
+                try:
+                    rv = await self._list(session)
+                    self.last_error = None
+                    await self._watch(session, rv)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # API hiccup → backoff + full re-list
+                    if str(e) != str(self.last_error or ""):
+                        log.warning("k8s pod watch (%s ns=%s): %s — re-listing "
+                                    "every %.1fs", self._label_selector,
+                                    self.namespace, e, self.backoff)
+                    self.last_error = e
+                await asyncio.sleep(self.backoff)
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

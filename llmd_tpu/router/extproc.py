@@ -1,0 +1,271 @@
+"""Gateway-mode EPP: the Envoy external-processing (ext_proc) gRPC front.
+
+In gateway mode the reference's EPP does not proxy traffic itself — an Envoy
+(or any GAIE-conformant gateway) parks each request and consults the EPP over
+the ext_proc bidirectional stream; the EPP answers with header mutations naming
+the chosen pod (``x-gateway-destination-endpoint``) and Envoy forwards
+(/root/reference/docs/architecture/core/router/proxy.md:3-111,
+docs/architecture/core/router/epp/README.md:13-16). This module is that server
+for the TPU stack: it reuses the standalone RouterServer's scheduling plane
+(parser → flow control → producers → scheduler) and speaks the ext_proc wire
+protocol via the checked-in clean-room proto subset
+(protos/ext_proc.proto, wire-compatible field numbers), registered under
+Envoy's full method name so a real Envoy can front it.
+
+Phase handling (buffered / FULL_DUPLEX-style chunked bodies both work):
+- request_headers → captured; CONTINUE.
+- request_body chunks → buffered; non-final chunks CONTINUE; the final chunk
+  triggers the pick and its BodyResponse carries the routing header mutation
+  (+ body mutation when InferenceModelRewrite rewrote the model name).
+- flow-control rejection / no endpoint → ImmediateResponse with the
+  flow-control outcome's HTTP status — unless the InferencePool's
+  ``failureMode`` is FailOpen, in which case CONTINUE without a mutation lets
+  the gateway fall back to its default routing
+  (docs/api-reference/inferencepool.md failureMode semantics).
+- response_headers/response_body → observed for usage/latency feedback
+  (scheduler.post_response drives the inflight/latency producers); CONTINUE.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from concurrent import futures
+from typing import Iterator, Optional
+
+import grpc
+
+from llmd_tpu.router import ext_proc_pb2 as pb
+from llmd_tpu.router.server import RouterServer
+
+# Envoy's service/method name — what an ext_proc filter dials.
+ENVOY_SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
+HDR_DESTINATION = "x-gateway-destination-endpoint"
+
+
+def _headers_to_dict(hm: pb.HeaderMap) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for h in hm.headers:
+        v = h.value or (h.raw_value.decode("utf-8", "replace") if h.raw_value else "")
+        out[h.key.lower()] = v
+    return out
+
+
+def _mutation(headers: dict[str, str]) -> pb.HeaderMutation:
+    return pb.HeaderMutation(set_headers=[
+        pb.HeaderValueOption(
+            header=pb.HeaderValue(key=k, raw_value=v.encode()),
+            append_action=2,  # OVERWRITE_IF_EXISTS_OR_ADD
+        )
+        for k, v in headers.items()
+    ])
+
+
+def _continue_headers() -> pb.ProcessingResponse:
+    return pb.ProcessingResponse(request_headers=pb.HeadersResponse(
+        response=pb.CommonResponse(status=pb.CommonResponse.CONTINUE)))
+
+
+class _Stream:
+    """Per-request state across the phases of one ext_proc stream."""
+
+    def __init__(self) -> None:
+        self.headers: dict[str, str] = {}
+        self.path = "/v1/completions"
+        self.body = bytearray()
+        self.resp_body = bytearray()
+        self.req = None
+        self.endpoint = None
+        self.t_start = time.monotonic()
+        self.resp_status = 0
+
+
+class ExtProcEPP:
+    """ext_proc gRPC server over an existing RouterServer's scheduling plane."""
+
+    def __init__(self, router: RouterServer, host: str = "0.0.0.0", port: int = 0,
+                 failure_mode: str = "FailClose", max_streams: int = 256) -> None:
+        self.router = router
+        self.host, self.port = host, port
+        self.failure_mode = failure_mode
+        # one worker thread is pinned per ext_proc stream for the stream's whole
+        # HTTP lifetime (sync gRPC server); max_streams bounds concurrency and
+        # excess streams are REJECTED (RESOURCE_EXHAUSTED) rather than queued
+        # behind long LLM responses
+        self.max_streams = max_streams
+        self._server: Optional[grpc.Server] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.metrics = {"streams_total": 0, "picks_total": 0,
+                        "immediate_total": 0, "fail_open_total": 0}
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        """Must be awaited from the router's event loop (flow control and async
+        producers are loop-bound; grpc worker threads bounce through it)."""
+        self._loop = asyncio.get_running_loop()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=self.max_streams, thread_name_prefix="extproc"),
+            maximum_concurrent_rpcs=self.max_streams,
+        )
+        rpc = grpc.stream_stream_rpc_method_handler(
+            self._process,
+            request_deserializer=pb.ProcessingRequest.FromString,
+            response_serializer=pb.ProcessingResponse.SerializeToString,
+        )
+        self._server.add_generic_rpc_handlers((
+            grpc.method_handlers_generic_handler(ENVOY_SERVICE, {"Process": rpc}),
+        ))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self._server.start()
+        if self.prometheus_lines not in self.router.extra_metrics:
+            self.router.extra_metrics.append(self.prometheus_lines)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # -- helpers -----------------------------------------------------------
+    def _await(self, coro, timeout: float = 600.0):
+        """Run a coroutine on the router loop from a grpc worker thread."""
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def _immediate(self, status: int, message: str) -> pb.ProcessingResponse:
+        self.metrics["immediate_total"] += 1
+        body = json.dumps({"error": {"message": message}}).encode()
+        return pb.ProcessingResponse(immediate_response=pb.ImmediateResponse(
+            status=pb.HttpStatus(code=status), body=body, details=message))
+
+    @staticmethod
+    def _wrap(phase: str, common: pb.CommonResponse) -> pb.ProcessingResponse:
+        """Envoy requires the response oneof to match the request phase."""
+        if phase == "request_headers":
+            return pb.ProcessingResponse(
+                request_headers=pb.HeadersResponse(response=common))
+        return pb.ProcessingResponse(request_body=pb.BodyResponse(response=common))
+
+    def _fail(self, st: _Stream, phase: str, status: int,
+              message: str) -> pb.ProcessingResponse:
+        """Reject per the pool's failureMode: FailClose answers for the gateway,
+        FailOpen lets it forward unrouted (inferencepool.md failureMode)."""
+        if self.failure_mode == "FailOpen":
+            self.metrics["fail_open_total"] += 1
+            return self._wrap(phase, pb.CommonResponse(
+                status=pb.CommonResponse.CONTINUE))
+        return self._immediate(status, message)
+
+    # -- the pick ----------------------------------------------------------
+    def _pick(self, st: _Stream, phase: str = "request_body") -> pb.ProcessingResponse:
+        r = self.router
+        try:
+            body = json.loads(bytes(st.body) or b"{}")
+        except json.JSONDecodeError:
+            return self._immediate(400, "invalid JSON body")
+        rewritten = dict(body)
+        req = r.prepare_request(st.path, rewritten, st.headers)
+        st.req = req
+        # one admission semantics with the standalone HTTP front
+        result, err = self._await(r.admit_and_schedule(req))
+        if err is not None:
+            status, message = err
+            return self._fail(st, phase, status, message)
+        st.endpoint = result.endpoint
+        self.metrics["picks_total"] += 1
+
+        from llmd_tpu.core.request import HDR_PREFILLER_HOST_PORT
+
+        hdrs = {
+            HDR_DESTINATION: result.endpoint.address,
+            "x-llm-d-endpoint": result.endpoint.address,
+            "x-llm-d-request-id": req.request_id,
+        }
+        if result.prefill_endpoint is not None:
+            hdrs[HDR_PREFILLER_HOST_PORT] = result.prefill_endpoint.address
+        common = pb.CommonResponse(
+            status=pb.CommonResponse.CONTINUE,
+            header_mutation=_mutation(hdrs),
+            clear_route_cache=True,
+        )
+        if rewritten.get("model") != body.get("model") and phase == "request_body":
+            common.status = pb.CommonResponse.CONTINUE_AND_REPLACE
+            common.body_mutation.body = json.dumps(rewritten).encode()
+        return self._wrap(phase, common)
+
+    def _finish(self, st: _Stream) -> None:
+        """Feed the response back to the latency/inflight producers."""
+        if st.req is None or st.endpoint is None:
+            return
+        info = {"status": st.resp_status,
+                "e2e_ms": (time.monotonic() - st.t_start) * 1e3}
+        try:
+            usage = json.loads(bytes(st.resp_body)).get("usage", {})
+            info["usage"] = usage
+            if usage.get("completion_tokens"):
+                info["itl_ms"] = info["e2e_ms"] / usage["completion_tokens"]
+        except Exception:
+            pass
+        self.router.scheduler.post_response(st.req, st.endpoint, info)
+        st.req = None  # post once
+
+    # -- stream handler ----------------------------------------------------
+    def _process(self, request_iterator: Iterator[pb.ProcessingRequest],
+                 context) -> Iterator[pb.ProcessingResponse]:
+        self.metrics["streams_total"] += 1
+        st = _Stream()
+        try:
+            for msg in request_iterator:
+                which = msg.WhichOneof("request")
+                if which == "request_headers":
+                    st.headers = _headers_to_dict(msg.request_headers.headers)
+                    st.path = st.headers.get(":path", st.path)
+                    if msg.request_headers.end_of_stream:
+                        # no body (GET-ish) — pick on headers alone
+                        yield self._pick(st, phase="request_headers")
+                    else:
+                        yield _continue_headers()
+                elif which == "request_body":
+                    st.body.extend(msg.request_body.body)
+                    if msg.request_body.end_of_stream:
+                        yield self._pick(st)
+                    else:
+                        yield pb.ProcessingResponse(request_body=pb.BodyResponse(
+                            response=pb.CommonResponse(
+                                status=pb.CommonResponse.CONTINUE)))
+                elif which == "response_headers":
+                    rh = _headers_to_dict(msg.response_headers.headers)
+                    st.resp_status = int(rh.get(":status", "0") or 0)
+                    if msg.response_headers.end_of_stream:
+                        self._finish(st)
+                    yield pb.ProcessingResponse(response_headers=pb.HeadersResponse(
+                        response=pb.CommonResponse(
+                            status=pb.CommonResponse.CONTINUE)))
+                elif which == "response_body":
+                    st.resp_body.extend(msg.response_body.body)
+                    if msg.response_body.end_of_stream:
+                        self._finish(st)
+                    yield pb.ProcessingResponse(response_body=pb.BodyResponse(
+                        response=pb.CommonResponse(
+                            status=pb.CommonResponse.CONTINUE)))
+                elif which == "request_trailers":
+                    yield pb.ProcessingResponse(
+                        request_trailers=pb.TrailersResponse())
+                elif which == "response_trailers":
+                    self._finish(st)
+                    yield pb.ProcessingResponse(
+                        response_trailers=pb.TrailersResponse())
+        finally:
+            self._finish(st)
+
+    def prometheus_lines(self) -> list[str]:
+        m = self.metrics
+        return [
+            f"llm_d_epp_extproc_streams_total {m['streams_total']}",
+            f"llm_d_epp_extproc_picks_total {m['picks_total']}",
+            f"llm_d_epp_extproc_immediate_total {m['immediate_total']}",
+            f"llm_d_epp_extproc_fail_open_total {m['fail_open_total']}",
+        ]

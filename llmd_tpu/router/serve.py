@@ -42,6 +42,15 @@ def main() -> None:
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=8080)
     ap.add_argument("--poll-interval", type=float, default=0.5)
+    ap.add_argument("--extproc-port", type=int, default=None,
+                    help="gateway mode: serve the Envoy ext_proc EPP gRPC here "
+                         "(the HTTP port keeps serving /metrics and /health)")
+    ap.add_argument("--manifests", default=None,
+                    help="InferencePool/InferenceObjective/InferenceModelRewrite/"
+                         "VariantAutoscaling YAML (multi-doc)")
+    ap.add_argument("--k8s-discovery", action="store_true",
+                    help="discover endpoints by watching pods matching the "
+                         "manifest InferencePool's selector/targetPorts")
     args = ap.parse_args()
 
     from llmd_tpu.core.config import FrameworkConfig
@@ -50,7 +59,7 @@ def main() -> None:
     from llmd_tpu.router import plugins as _p  # noqa: F401
     from llmd_tpu.router import filters_pickers as _fp  # noqa: F401
     from llmd_tpu.router import scorers as _s  # noqa: F401
-    from llmd_tpu.router.datalayer import add_static_endpoints, load_endpoints_file
+    from llmd_tpu.router.datalayer import add_static_endpoints
     from llmd_tpu.router.plugins import known_plugin_types
     from llmd_tpu.router.server import RouterServer
 
@@ -61,19 +70,62 @@ def main() -> None:
         text = DEFAULT_CONFIG
     config = FrameworkConfig.from_yaml(text, known_types=known_plugin_types())
 
+    manifests = None
+    if args.manifests:
+        from llmd_tpu.core.crds import load_manifest_yaml
+
+        with open(args.manifests) as f:
+            manifests = load_manifest_yaml(f.read())
+
     pool = EndpointPool()
+    sources = []
     if args.endpoints_file:
-        load_endpoints_file(pool, args.endpoints_file)
+        from llmd_tpu.router.discovery import FileSource
+
+        sources.append(FileSource(pool, args.endpoints_file))
     if args.endpoints:
         add_static_endpoints(pool, args.endpoints.split(","))
+    if args.k8s_discovery:
+        if not manifests or not manifests.pools:
+            raise SystemExit("--k8s-discovery needs --manifests with an InferencePool")
+        from llmd_tpu.router.discovery import K8sWatchSource
 
-    server = RouterServer(config, pool, host=args.host, port=args.port,
-                          poll_interval_s=args.poll_interval)
+        # every InferencePool in the manifest gets its own watch (e.g. separate
+        # prefill/decode pools); all feed the one EndpointPool
+        for p in manifests.pools:
+            sources.append(K8sWatchSource(pool, p.selector, p.target_ports,
+                                          namespace=p.namespace))
+
+    server = RouterServer(
+        config, pool, host=args.host, port=args.port,
+        poll_interval_s=args.poll_interval,
+        objectives=manifests.objectives_map() if manifests else None,
+        model_rewrites=manifests.rewrites_map() if manifests else None,
+    )
 
     async def run() -> None:
         await server.start()
-        print(f"llmd-tpu router on http://{server.address} "
-              f"({len(pool)} endpoints)", flush=True)
+        for src in sources:
+            await src.start()
+        discovery = (f"{len(pool)} endpoints"
+                     if not args.k8s_discovery
+                     else f"{len(pool)} endpoints at startup; k8s watch active "
+                          f"({len(sources)} pool(s))")
+        msg = f"llmd-tpu router on http://{server.address} ({discovery})"
+        if args.extproc_port is not None:
+            from llmd_tpu.router.extproc import ExtProcEPP
+
+            modes = {p.failure_mode for p in manifests.pools} if manifests and manifests.pools else set()
+            if len(modes) > 1:
+                print(f"warning: mixed failureModes {sorted(modes)}; "
+                      "FailOpen wins for the shared EPP", flush=True)
+            failure_mode = ("FailOpen" if "FailOpen" in modes
+                            else "FailClose")
+            epp = ExtProcEPP(server, host=args.host, port=args.extproc_port,
+                             failure_mode=failure_mode)
+            await epp.start()
+            msg += f"; ext-proc EPP on grpc://{epp.address} ({failure_mode})"
+        print(msg, flush=True)
         await asyncio.Event().wait()
 
     asyncio.run(run())

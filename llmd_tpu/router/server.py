@@ -129,6 +129,9 @@ class RouterServer:
         from llmd_tpu.obs.tracing import global_tracer
 
         self.tracer = global_tracer()
+        # extra Prometheus providers (ext-proc EPP front, HA coordinator, ...):
+        # callables returning lines, appended to /metrics
+        self.extra_metrics: list[Any] = []
 
     @property
     def address(self) -> str:
@@ -188,6 +191,43 @@ class RouterServer:
                 return
         self._e2e_counts[-1] += 1
 
+    def prepare_request(self, path: str, body: dict,
+                        headers: dict[str, str]) -> InferenceRequest:
+        """Parse + apply objectives and model rewrite (mutates ``body`` on
+        rewrite). Shared preamble of the standalone HTTP path and the
+        gateway-mode ext-proc path."""
+        req = parse_openai_request(path, body, headers)
+        lower = {k.lower(): v for k, v in headers.items()}
+        req.request_id = lower.get("x-request-id", uuid.uuid4().hex)
+        if req.objective and req.objective in self.objectives:
+            req.priority = self.objectives[req.objective]
+        self._rewrite_model(req, body)
+        return req
+
+    async def admit_and_schedule(self, req: InferenceRequest, span=None):
+        """Flow-control gate → async producers → scheduler pick.
+
+        Returns (result, None) on success or (None, (http_status, message)) on
+        rejection — one admission semantics for both serving fronts."""
+        if self.flow:
+            if span:
+                span.add_event("flow_control.enqueue")
+            outcome = await self.flow.enqueue_and_wait(req)
+            if outcome is not RequestOutcome.DISPATCHED:
+                self.metrics["errors_total"] += 1
+                return None, (outcome.http_status, f"flow control: {outcome.value}")
+        for p in self._async_producers:
+            await p.aproduce(req, self.pool.list(), self._session)
+        if span:
+            span.add_event("schedule.start")
+        result = await asyncio.get_running_loop().run_in_executor(
+            self._sched_executor, self.scheduler.schedule, req
+        )
+        if result.endpoint is None:
+            self.metrics["errors_total"] += 1
+            return None, (503, f"no endpoint: {result.rejected}")
+        return result, None
+
     async def _handle_generate(self, request: web.Request):
         t_start = time.monotonic()
         self.metrics["requests_total"] += 1
@@ -196,11 +236,7 @@ class RouterServer:
         except Exception:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
         headers = dict(request.headers)
-        req = parse_openai_request(request.path, body, headers)
-        req.request_id = request.headers.get("x-request-id", uuid.uuid4().hex)
-        if req.objective and req.objective in self.objectives:
-            req.priority = self.objectives[req.objective]
-        self._rewrite_model(req, body)
+        req = self.prepare_request(request.path, body, headers)
 
         from llmd_tpu.obs.tracing import extract_traceparent
 
@@ -209,31 +245,12 @@ class RouterServer:
             **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
                "http.route": request.path})
 
-        if self.flow:
-            span.add_event("flow_control.enqueue")
-            outcome = await self.flow.enqueue_and_wait(req)
-            if outcome is not RequestOutcome.DISPATCHED:
-                self.metrics["errors_total"] += 1
-                span.set_error(f"flow control: {outcome.value}")
-                span.end()
-                return web.json_response(
-                    {"error": {"message": f"flow control: {outcome.value}"}},
-                    status=outcome.http_status,
-                )
-
-        for p in self._async_producers:
-            await p.aproduce(req, self.pool.list(), self._session)
-        span.add_event("schedule.start")
-        result = await asyncio.get_running_loop().run_in_executor(
-            self._sched_executor, self.scheduler.schedule, req
-        )
-        if result.endpoint is None:
-            self.metrics["errors_total"] += 1
-            span.set_error(f"no endpoint: {result.rejected}")
+        result, err = await self.admit_and_schedule(req, span=span)
+        if err is not None:
+            status, message = err
+            span.set_error(message)
             span.end()
-            return web.json_response(
-                {"error": {"message": f"no endpoint: {result.rejected}"}}, status=503
-            )
+            return web.json_response({"error": {"message": message}}, status=status)
         span.set_attribute("llm_d.endpoint", result.endpoint.address)
         span.add_event("proxy.forward")
 
@@ -364,6 +381,8 @@ class RouterServer:
         for plugin in self.scheduler.plugins.values():
             if hasattr(plugin, "prometheus_lines"):
                 lines += plugin.prometheus_lines()
+        for provider in self.extra_metrics:
+            lines += provider()
         return web.Response(text="\n".join(lines) + "\n")
 
     async def _health(self, request: web.Request):

@@ -1,0 +1,83 @@
+"""CRD-shaped API surface: manifest parsing + validation semantics."""
+
+from __future__ import annotations
+
+import conftest  # noqa: F401
+
+import pytest
+
+from llmd_tpu.core.crds import (
+    InferencePool,
+    ManifestError,
+    load_manifest_yaml,
+)
+
+MANIFESTS = """
+apiVersion: inference.networking.k8s.io/v1
+kind: InferencePool
+metadata: {name: pool-a, namespace: prod}
+spec:
+  selector: {matchLabels: {app: ms}}
+  targetPorts: [{number: 8000}, {number: 8001}]
+  endpointPickerRef: {name: epp, port: 9002, failureMode: FailOpen}
+---
+apiVersion: llm-d.ai/v1alpha2
+kind: InferenceObjective
+metadata: {name: premium}
+spec: {priority: 10, poolRef: {name: pool-a}}
+---
+kind: InferenceModelRewrite
+metadata: {name: canary}
+spec:
+  modelName: my-model
+  targetModels:
+    - {modelName: my-model-v1, weight: 9}
+    - {modelName: my-model-v2, weight: 1}
+---
+kind: VariantAutoscaling
+metadata: {name: va}
+spec:
+  modelID: my-model
+  minReplicas: 0
+  maxReplicas: 4
+  slo: {ttftMs: 500, tpotMs: 50}
+"""
+
+
+def test_load_manifest_set():
+    ms = load_manifest_yaml(MANIFESTS)
+    assert len(ms.pools) == 1 and ms.pools[0].target_ports == [8000, 8001]
+    assert ms.pools[0].failure_mode == "FailOpen"
+    assert ms.pools[0].selector == {"app": "ms"}
+    assert ms.objectives_map() == {"premium": 10}
+    assert ms.rewrites_map() == {"my-model": [("my-model-v1", 9.0),
+                                              ("my-model-v2", 1.0)]}
+    assert ms.autoscalings[0].slo_ttft_ms == 500
+
+
+def test_target_ports_limit():
+    with pytest.raises(ManifestError, match="8-port"):
+        InferencePool(name="x", selector={"a": "b"},
+                      target_ports=list(range(8000, 8009)))
+
+
+def test_failure_mode_validated():
+    bad = MANIFESTS.replace("FailOpen", "Explode")
+    with pytest.raises(ManifestError, match="failureMode"):
+        load_manifest_yaml(bad)
+
+
+def test_objective_pool_ref_cross_validated():
+    bad = MANIFESTS.replace("poolRef: {name: pool-a}", "poolRef: {name: nope}")
+    with pytest.raises(ManifestError, match="matches no"):
+        load_manifest_yaml(bad)
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ManifestError, match="unknown kind"):
+        load_manifest_yaml("kind: Gadget\nmetadata: {name: g}\n")
+
+
+def test_duplicate_ports_rejected():
+    with pytest.raises(ManifestError, match="duplicate"):
+        InferencePool(name="x", selector={"a": "b"}, target_ports=[8000, 8000])

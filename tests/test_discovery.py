@@ -1,0 +1,187 @@
+"""Endpoint discovery sources: live file re-scan + Kubernetes pod watch.
+
+K8sWatchSource is exercised against a fake Kubernetes API server (aiohttp):
+list seeding, watch ADDED/MODIFIED/DELETED, readiness gating, multi-port pools
+(one endpoint per podIP:port — inferencepool.md targetPorts), and re-list
+recovery after the watch stream drops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import conftest  # noqa: F401
+from conftest import run_async
+
+from aiohttp import web
+
+from llmd_tpu.core.endpoint import EndpointPool
+from llmd_tpu.router.discovery import FileSource, K8sWatchSource
+
+
+def _pod(name: str, ip: str, ready: bool = True, phase: str = "Running",
+         labels: dict | None = None, uid: str | None = None) -> dict:
+    return {
+        "metadata": {"name": name, "uid": uid or f"uid-{name}",
+                     "labels": {"app": "ms", **(labels or {})}},
+        "status": {
+            "phase": phase, "podIP": ip,
+            "conditions": [{"type": "Ready",
+                            "status": "True" if ready else "False"}],
+        },
+    }
+
+
+class FakeK8s:
+    """Minimal pods list+watch API."""
+
+    def __init__(self) -> None:
+        self.pods: dict[str, dict] = {}
+        self.watchers: list[asyncio.Queue] = []
+        self.list_calls = 0
+        self._runner = None
+        self.port = 0
+
+    async def start(self) -> None:
+        app = web.Application()
+        app.router.add_get("/api/v1/namespaces/{ns}/pods", self._pods)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for q in self.watchers:
+            q.put_nowait(None)
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _pods(self, request: web.Request):
+        if request.query.get("watch"):
+            resp = web.StreamResponse()
+            await resp.prepare(request)
+            q: asyncio.Queue = asyncio.Queue()
+            self.watchers.append(q)
+            try:
+                while True:
+                    ev = await q.get()
+                    if ev is None:
+                        break
+                    await resp.write((json.dumps(ev) + "\n").encode())
+            finally:
+                self.watchers.remove(q)
+            return resp
+        self.list_calls += 1
+        return web.json_response({
+            "items": list(self.pods.values()),
+            "metadata": {"resourceVersion": "1"},
+        })
+
+    def event(self, etype: str, pod: dict) -> None:
+        if etype == "DELETED":
+            self.pods.pop(pod["metadata"]["uid"], None)
+        else:
+            self.pods[pod["metadata"]["uid"]] = pod
+        for q in self.watchers:
+            q.put_nowait({"type": etype, "object": pod})
+
+
+async def _wait_for(cond, timeout=5.0):
+    for _ in range(int(timeout / 0.02)):
+        if cond():
+            return True
+        await asyncio.sleep(0.02)
+    return False
+
+
+def test_file_source_rescan(tmp_path):
+    path = tmp_path / "eps.txt"
+    path.write_text("10.0.0.1:8000,both\n10.0.0.2:8000,decode\n")
+
+    async def main():
+        pool = EndpointPool()
+        src = FileSource(pool, str(path), rescan_interval_s=0.05)
+        await src.start()
+        assert {e.address for e in pool.list()} == {"10.0.0.1:8000", "10.0.0.2:8000"}
+        path.write_text("10.0.0.2:8000,decode\n10.0.0.3:8000,prefill\n")
+        ok = await _wait_for(lambda: {e.address for e in pool.list()} ==
+                             {"10.0.0.2:8000", "10.0.0.3:8000"})
+        assert ok, [e.address for e in pool.list()]
+        await src.stop()
+
+    run_async(main())
+
+
+def test_k8s_watch_lifecycle():
+    async def main():
+        api = FakeK8s()
+        await api.start()
+        api.pods["uid-a"] = _pod("a", "10.1.0.1")
+        pool = EndpointPool()
+        src = K8sWatchSource(
+            pool, {"app": "ms"}, ports=[8000, 8001], namespace="ns",
+            api_base=f"http://127.0.0.1:{api.port}", token="t", rebackoff_s=0.05,
+        )
+        await src.start()
+        # list seeding: one endpoint per podIP:port
+        assert await _wait_for(lambda: len(pool.list()) == 2)
+        assert {e.address for e in pool.list()} == {"10.1.0.1:8000", "10.1.0.1:8001"}
+
+        # watch ADDED
+        api.event("ADDED", _pod("b", "10.1.0.2"))
+        assert await _wait_for(lambda: len(pool.list()) == 4)
+
+        # readiness flips to False → removed on MODIFIED
+        api.event("MODIFIED", _pod("b", "10.1.0.2", ready=False))
+        assert await _wait_for(lambda: len(pool.list()) == 2)
+
+        # DELETED removes
+        api.event("DELETED", _pod("a", "10.1.0.1"))
+        assert await _wait_for(lambda: len(pool.list()) == 0)
+        await src.stop()
+        await api.stop()
+
+    run_async(main())
+
+
+def test_k8s_watch_relists_after_stream_drop():
+    async def main():
+        api = FakeK8s()
+        await api.start()
+        api.pods["uid-a"] = _pod("a", "10.2.0.1")
+        pool = EndpointPool()
+        src = K8sWatchSource(
+            pool, {"app": "ms"}, ports=[8000], namespace="ns",
+            api_base=f"http://127.0.0.1:{api.port}", token="t", rebackoff_s=0.05,
+        )
+        await src.start()
+        assert await _wait_for(lambda: len(pool.list()) == 1)
+        # pod appears while the stream is down: close watchers, mutate, re-list picks it up
+        api.pods["uid-c"] = _pod("c", "10.2.0.3")
+        for q in list(api.watchers):
+            q.put_nowait(None)
+        assert await _wait_for(lambda: len(pool.list()) == 2)
+        assert api.list_calls >= 2
+        await src.stop()
+        await api.stop()
+
+    run_async(main())
+
+
+def test_k8s_pod_role_label():
+    async def main():
+        api = FakeK8s()
+        await api.start()
+        api.pods["uid-p"] = _pod("p", "10.3.0.1", labels={"llm-d.ai/role": "prefill"})
+        pool = EndpointPool()
+        src = K8sWatchSource(pool, {"app": "ms"}, ports=[8000], namespace="ns",
+                             api_base=f"http://127.0.0.1:{api.port}", token="t")
+        await src.start()
+        assert await _wait_for(lambda: len(pool.list()) == 1)
+        assert pool.list()[0].role.value == "prefill"
+        await src.stop()
+        await api.stop()
+
+    run_async(main())

@@ -1,0 +1,224 @@
+"""Gateway-mode EPP: ext_proc gRPC protocol over the scheduling plane.
+
+The client fixture here plays Envoy's ext_proc filter: it opens the
+bidirectional stream at Envoy's full method name, sends
+request_headers → request_body(end_of_stream) → response phases, and asserts
+the EPP answers with the x-gateway-destination-endpoint header mutation (the
+GAIE endpoint-picking contract), immediate responses on rejection (FailClose),
+pass-through on FailOpen, and body mutation for model rewrites.
+"""
+
+from __future__ import annotations
+
+import json
+
+import conftest  # noqa: F401
+from conftest import run_async
+
+import grpc
+import pytest
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import EndpointPool
+from llmd_tpu.router import ext_proc_pb2 as pb
+from llmd_tpu.router import plugins as _p  # noqa: F401
+from llmd_tpu.router import scorers as _s  # noqa: F401
+from llmd_tpu.router.extproc import ENVOY_SERVICE, HDR_DESTINATION, ExtProcEPP
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.server import RouterServer
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+
+CONFIG = """
+plugins:
+  - name: queue
+    type: queue-depth-scorer
+  - name: inflight
+    type: inflight-load-producer
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 2}
+"""
+
+
+def _stub(addr: str):
+    channel = grpc.insecure_channel(addr)
+    return channel, channel.stream_stream(
+        f"/{ENVOY_SERVICE}/Process",
+        request_serializer=pb.ProcessingRequest.SerializeToString,
+        response_deserializer=pb.ProcessingResponse.FromString,
+    )
+
+
+def _req_messages(body: dict, path: str = "/v1/completions", chunks: int = 1):
+    yield pb.ProcessingRequest(request_headers=pb.HttpHeaders(
+        headers=pb.HeaderMap(headers=[
+            pb.HeaderValue(key=":path", value=path),
+            pb.HeaderValue(key=":method", value="POST"),
+            pb.HeaderValue(key="x-request-id", value="extproc-test-1"),
+        ])))
+    raw = json.dumps(body).encode()
+    step = max(1, len(raw) // chunks)
+    offs = list(range(0, len(raw), step))
+    for i, off in enumerate(offs):
+        yield pb.ProcessingRequest(request_body=pb.HttpBody(
+            body=raw[off:off + step], end_of_stream=i == len(offs) - 1))
+
+
+def _set_headers(resp: pb.ProcessingResponse) -> dict[str, str]:
+    which = resp.WhichOneof("response")
+    common = getattr(resp, which).response
+    return {o.header.key: (o.header.value or o.header.raw_value.decode())
+            for o in common.header_mutation.set_headers}
+
+
+@pytest.fixture()
+def stack():
+    """Two fake model servers + RouterServer scheduling plane + ExtProcEPP."""
+    holder = {}
+
+    async def setup():
+        fakes = [FakeModelServer(FakeServerConfig(), port=0) for _ in range(2)]
+        pool = EndpointPool()
+        for f in fakes:
+            await f.start()
+        from llmd_tpu.router.datalayer import add_static_endpoints
+
+        add_static_endpoints(pool, [f.address for f in fakes])
+        cfg = FrameworkConfig.from_yaml(CONFIG, known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0)
+        await router.start()
+        epp = ExtProcEPP(router, host="127.0.0.1")
+        await epp.start()
+        holder.update(fakes=fakes, pool=pool, router=router, epp=epp)
+        return holder
+
+    async def teardown():
+        await holder["epp"].stop()
+        await holder["router"].stop()
+        for f in holder["fakes"]:
+            await f.stop()
+
+    import asyncio
+    import threading
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    asyncio.run_coroutine_threadsafe(setup(), loop).result(30)
+    try:
+        yield holder
+    finally:
+        asyncio.run_coroutine_threadsafe(teardown(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+
+
+def test_pick_via_extproc_stream(stack):
+    channel, stub = _stub(stack["epp"].address)
+    try:
+        resps = list(stub(_req_messages({"model": "m", "prompt": "hello",
+                                         "max_tokens": 4})))
+        assert resps[0].WhichOneof("response") == "request_headers"
+        assert resps[1].WhichOneof("response") == "request_body"
+        hdrs = _set_headers(resps[1])
+        dests = {f.address for f in stack["fakes"]}
+        assert hdrs[HDR_DESTINATION] in dests
+        assert hdrs["x-llm-d-request-id"] == "extproc-test-1"
+        assert resps[1].request_body.response.clear_route_cache
+    finally:
+        channel.close()
+
+
+def test_chunked_body_full_duplex(stack):
+    """FULL_DUPLEX-style chunked request body: per-chunk CONTINUE, pick on the
+    final chunk."""
+    channel, stub = _stub(stack["epp"].address)
+    try:
+        resps = list(stub(_req_messages({"model": "m", "prompt": "x" * 256,
+                                         "max_tokens": 2}, chunks=4)))
+        body_resps = [r for r in resps if r.WhichOneof("response") == "request_body"]
+        assert len(body_resps) >= 2
+        assert HDR_DESTINATION in _set_headers(body_resps[-1])
+        for r in body_resps[:-1]:
+            assert not r.request_body.response.header_mutation.set_headers
+    finally:
+        channel.close()
+
+
+def test_response_phase_feeds_usage(stack):
+    channel, stub = _stub(stack["epp"].address)
+    try:
+        def msgs():
+            yield from _req_messages({"model": "m", "prompt": "p", "max_tokens": 2})
+            yield pb.ProcessingRequest(response_headers=pb.HttpHeaders(
+                headers=pb.HeaderMap(headers=[pb.HeaderValue(key=":status",
+                                                             value="200")])))
+            payload = json.dumps({"usage": {"completion_tokens": 2}}).encode()
+            yield pb.ProcessingRequest(response_body=pb.HttpBody(
+                body=payload, end_of_stream=True))
+
+        resps = list(stub(msgs()))
+        kinds = [r.WhichOneof("response") for r in resps]
+        assert kinds == ["request_headers", "request_body", "response_headers",
+                         "response_body"]
+        # inflight-load producer decremented back to zero after the response
+        inflight = stack["router"].ctx.get("inflight_requests", {})
+        assert all(v == 0 for v in inflight.values())
+    finally:
+        channel.close()
+
+
+def test_immediate_response_fail_close():
+    async def setup():
+        pool = EndpointPool()  # empty — nothing to route to
+        cfg = FrameworkConfig.from_yaml(CONFIG, known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0)
+        await router.start()
+        epp = ExtProcEPP(router, host="127.0.0.1", failure_mode="FailClose")
+        await epp.start()
+        return router, epp
+
+    import asyncio
+    import threading
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    router, epp = asyncio.run_coroutine_threadsafe(
+        asyncio.wait_for(setup(), 30), loop).result(30)
+    try:
+        channel, stub = _stub(epp.address)
+        resps = list(stub(_req_messages({"model": "m", "prompt": "p"})))
+        assert resps[-1].WhichOneof("response") == "immediate_response"
+        assert resps[-1].immediate_response.status.code == 503
+        channel.close()
+
+        epp.failure_mode = "FailOpen"
+        channel, stub = _stub(epp.address)
+        resps = list(stub(_req_messages({"model": "m", "prompt": "p"})))
+        assert resps[-1].WhichOneof("response") == "request_body"
+        assert not resps[-1].request_body.response.header_mutation.set_headers
+        channel.close()
+    finally:
+        async def td():
+            await epp.stop()
+            await router.stop()
+
+        asyncio.run_coroutine_threadsafe(td(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+
+
+def test_model_rewrite_body_mutation(stack):
+    stack["router"].model_rewrites["alias"] = [("real-model", 1.0)]
+    channel, stub = _stub(stack["epp"].address)
+    try:
+        resps = list(stub(_req_messages({"model": "alias", "prompt": "p",
+                                         "max_tokens": 2})))
+        final = resps[-1].request_body.response
+        assert final.status == pb.CommonResponse.CONTINUE_AND_REPLACE
+        assert json.loads(final.body_mutation.body)["model"] == "real-model"
+    finally:
+        channel.close()
+        stack["router"].model_rewrites.pop("alias", None)

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Regenerate checked-in protobuf modules (protoc is baked into the image;
+# grpcio-tools is not, so the gRPC service is wired via generic handlers in
+# llmd_tpu/router/extproc.py instead of a generated stub).
+set -e
+cd "$(dirname "$0")/.."
+protoc --python_out=llmd_tpu/router --proto_path=protos protos/ext_proc.proto
+echo "wrote llmd_tpu/router/ext_proc_pb2.py"
