@@ -176,11 +176,12 @@ class VariantAutoscaling:
         )
 
 
+# single registry: kind → (parser, ManifestSet attribute)
 _KINDS = {
-    "InferencePool": InferencePool.from_manifest,
-    "InferenceObjective": InferenceObjective.from_manifest,
-    "InferenceModelRewrite": InferenceModelRewrite.from_manifest,
-    "VariantAutoscaling": VariantAutoscaling.from_manifest,
+    "InferencePool": (InferencePool.from_manifest, "pools"),
+    "InferenceObjective": (InferenceObjective.from_manifest, "objectives"),
+    "InferenceModelRewrite": (InferenceModelRewrite.from_manifest, "rewrites"),
+    "VariantAutoscaling": (VariantAutoscaling.from_manifest, "autoscalings"),
 }
 
 
@@ -207,16 +208,11 @@ def load_manifests(docs: list[dict]) -> ManifestSet:
         if not doc:
             continue
         kind = doc.get("kind", "")
-        fn = _KINDS.get(kind)
-        if fn is None:
+        entry = _KINDS.get(kind)
+        if entry is None:
             raise ManifestError(f"unknown kind {kind!r}")
-        obj = fn(doc)
-        {
-            "InferencePool": out.pools,
-            "InferenceObjective": out.objectives,
-            "InferenceModelRewrite": out.rewrites,
-            "VariantAutoscaling": out.autoscalings,
-        }[kind].append(obj)
+        fn, attr = entry
+        getattr(out, attr).append(fn(doc))
     pool_names = {p.name for p in out.pools}
     for o in out.objectives:
         if o.pool_ref and pool_names and o.pool_ref not in pool_names:

@@ -158,3 +158,38 @@ class RoutingSidecar:
             return web.json_response(
                 {"error": {"message": f"decode engine unreachable: {e}"}}, status=502
             )
+
+
+def main() -> None:
+    """CLI: python -m llmd_tpu.disagg.sidecar --port 8000 --engine 127.0.0.1:8200
+
+    Deployment entrypoint (reference patch-sidecar.yaml: sidecar on the pod's
+    serving port, engine on the local port behind it)."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--engine", default="127.0.0.1:8200",
+                    help="local decode engine address")
+    ap.add_argument("--enable-prefiller-sampling", action="store_true")
+    ap.add_argument("--prefill-timeout", type=float, default=120.0)
+    args = ap.parse_args()
+
+    sidecar = RoutingSidecar(
+        args.engine, host=args.host, port=args.port,
+        enable_prefiller_sampling=args.enable_prefiller_sampling,
+        prefill_timeout_s=args.prefill_timeout,
+    )
+
+    async def run() -> None:
+        await sidecar.start()
+        print(f"llmd-tpu routing sidecar on http://{sidecar.address} "
+              f"-> engine {args.engine}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

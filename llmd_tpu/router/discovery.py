@@ -158,10 +158,10 @@ class K8sWatchSource(EndpointSource):
 
     @staticmethod
     def _in_cluster_base() -> str:
+        # the in-cluster API server is always TLS regardless of port
         host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
-        scheme = "https" if port == "443" else "http"
-        return f"{scheme}://{host}:{port}"
+        return f"https://{host}:{port}"
 
     @staticmethod
     def _in_cluster_token() -> Optional[str]:
@@ -203,7 +203,9 @@ class K8sWatchSource(EndpointSource):
         seen_uids = set()
         for pod in data.get("items", []):
             self._apply(pod, deleted=False)
-            seen_uids.add(pod.get("metadata", {}).get("uid", ""))
+            meta = pod.get("metadata", {})
+            # same key fallback as _apply, or uid-less pods would be swept
+            seen_uids.add(meta.get("uid") or meta.get("name", ""))
         for uid in list(self._addresses):
             if uid not in seen_uids:
                 self._apply({"metadata": {"uid": uid}}, deleted=True)

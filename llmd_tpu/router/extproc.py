@@ -70,11 +70,14 @@ def _continue_headers() -> pb.ProcessingResponse:
 class _Stream:
     """Per-request state across the phases of one ext_proc stream."""
 
+    RESP_BUFFER_CAP = 256 * 1024  # usage parse only needs the (small) JSON body
+
     def __init__(self) -> None:
         self.headers: dict[str, str] = {}
         self.path = "/v1/completions"
         self.body = bytearray()
         self.resp_body = bytearray()
+        self.resp_streaming = False  # SSE bodies carry no parseable usage JSON
         self.req = None
         self.endpoint = None
         self.t_start = time.monotonic()
@@ -149,11 +152,15 @@ class ExtProcEPP:
                 request_headers=pb.HeadersResponse(response=common))
         return pb.ProcessingResponse(request_body=pb.BodyResponse(response=common))
 
-    def _fail(self, st: _Stream, phase: str, status: int,
-              message: str) -> pb.ProcessingResponse:
-        """Reject per the pool's failureMode: FailClose answers for the gateway,
-        FailOpen lets it forward unrouted (inferencepool.md failureMode)."""
-        if self.failure_mode == "FailOpen":
+    def _fail(self, st: _Stream, phase: str, status: int, message: str,
+              deliberate: bool = False) -> pb.ProcessingResponse:
+        """Reject, honouring the pool's failureMode for EPP-side failures only.
+
+        failureMode governs what happens when the EPP *can't* answer
+        (inferencepool.md) — deliberate admission decisions (flow-control
+        shedding, priority rejection) are always enforced, or FailOpen would
+        disable load shedding exactly under the saturation it exists for."""
+        if self.failure_mode == "FailOpen" and not deliberate:
             self.metrics["fail_open_total"] += 1
             return self._wrap(phase, pb.CommonResponse(
                 status=pb.CommonResponse.CONTINUE))
@@ -170,10 +177,16 @@ class ExtProcEPP:
         req = r.prepare_request(st.path, rewritten, st.headers)
         st.req = req
         # one admission semantics with the standalone HTTP front
-        result, err = self._await(r.admit_and_schedule(req))
+        try:
+            result, err = self._await(r.admit_and_schedule(req))
+        except Exception as e:  # EPP-internal failure → failureMode applies
+            return self._fail(st, phase, 500, f"EPP error: {e}")
         if err is not None:
             status, message = err
-            return self._fail(st, phase, status, message)
+            # flow-control outcomes are deliberate shedding; "no endpoint" is
+            # an EPP-can't-answer condition the failureMode may pass through
+            return self._fail(st, phase, status, message,
+                              deliberate=message.startswith("flow control"))
         st.endpoint = result.endpoint
         self.metrics["picks_total"] += 1
 
@@ -192,12 +205,17 @@ class ExtProcEPP:
             clear_route_cache=True,
         )
         if rewritten.get("model") != body.get("model") and phase == "request_body":
-            common.status = pb.CommonResponse.CONTINUE_AND_REPLACE
+            # plain CONTINUE + body_mutation: CONTINUE_AND_REPLACE would stop
+            # Envoy sending the response phases, blinding usage/latency feedback
+            # for exactly the canary traffic the rewrite exists to measure
             common.body_mutation.body = json.dumps(rewritten).encode()
         return self._wrap(phase, common)
 
     def _finish(self, st: _Stream) -> None:
-        """Feed the response back to the latency/inflight producers."""
+        """Feed the response back to the latency/inflight producers — on the
+        router loop: producers' post_response mutates shared per-endpoint state
+        and the HTTP path posts from the loop, so gRPC worker threads must not
+        call it directly."""
         if st.req is None or st.endpoint is None:
             return
         info = {"status": st.resp_status,
@@ -209,8 +227,13 @@ class ExtProcEPP:
                 info["itl_ms"] = info["e2e_ms"] / usage["completion_tokens"]
         except Exception:
             pass
-        self.router.scheduler.post_response(st.req, st.endpoint, info)
+        req, ep = st.req, st.endpoint
         st.req = None  # post once
+        try:
+            self._loop.call_soon_threadsafe(
+                self.router.scheduler.post_response, req, ep, info)
+        except RuntimeError:
+            pass  # loop shut down mid-stream
 
     # -- stream handler ----------------------------------------------------
     def _process(self, request_iterator: Iterator[pb.ProcessingRequest],
@@ -239,13 +262,17 @@ class ExtProcEPP:
                 elif which == "response_headers":
                     rh = _headers_to_dict(msg.response_headers.headers)
                     st.resp_status = int(rh.get(":status", "0") or 0)
+                    st.resp_streaming = rh.get("content-type", "").startswith(
+                        "text/event-stream")
                     if msg.response_headers.end_of_stream:
                         self._finish(st)
                     yield pb.ProcessingResponse(response_headers=pb.HeadersResponse(
                         response=pb.CommonResponse(
                             status=pb.CommonResponse.CONTINUE)))
                 elif which == "response_body":
-                    st.resp_body.extend(msg.response_body.body)
+                    if (not st.resp_streaming
+                            and len(st.resp_body) < _Stream.RESP_BUFFER_CAP):
+                        st.resp_body.extend(msg.response_body.body)
                     if msg.response_body.end_of_stream:
                         self._finish(st)
                     yield pb.ProcessingResponse(response_body=pb.BodyResponse(

@@ -1,0 +1,275 @@
+"""EPP high availability: leader election (active-passive) + active-active.
+
+Reference semantics
+(/root/reference/docs/architecture/core/router/epp/configuration.md:455-459;
+docs/architecture/advanced/kv-management/kv-indexer.md:77-101):
+
+- **Active-passive** — EPP replicas > 1 run leader election; only the leader
+  answers picks, standbys take over when the leader's lease lapses. The k8s
+  deployment uses a coordination.k8s.io Lease (``K8sLease`` here, plain HTTP
+  API with resourceVersion optimistic concurrency); co-located processes (the
+  no-Kubernetes mode) use an flock-held ``FileLease`` — the OS drops the lock
+  on crash, so failover needs no timeout heuristics.
+- **Active-active** — for precise prefix routing, leader election is DISABLED
+  and every replica subscribes to all pods' KV event streams (pod-discovery
+  mode); each replica's index converges on the same state, so any replica
+  produces the same pick. There is no code to add for this beyond what
+  pod-discovery already does — tests/test_ha.py asserts the convergence
+  property across two full RouterServers.
+
+``attach_ha`` wires an elector into a RouterServer: standby replicas answer
+generate requests with 503 + ``x-llm-d-standby`` (the gateway's health checks
+move traffic to the leader) while /metrics & /health keep serving.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import calendar
+import os
+import time
+import uuid
+from typing import Callable, Optional
+
+import aiohttp
+
+
+class FileLease:
+    """flock-based lease for co-located replicas: the OS releases the lock the
+    instant the holder dies — crash failover without staleness heuristics."""
+
+    def __init__(self, path: str, identity: Optional[str] = None) -> None:
+        self.path = path
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self._fd: Optional[int] = None
+
+    def try_acquire(self) -> bool:
+        import fcntl
+
+        if self._fd is not None:
+            return True
+        fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        os.ftruncate(fd, 0)
+        os.pwrite(fd, self.identity.encode(), 0)
+        self._fd = fd
+        return True
+
+    def renew(self) -> bool:
+        return self._fd is not None  # flock holds until released/crash
+
+    def release(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)  # closes → flock released
+            self._fd = None
+
+    def holder(self) -> Optional[str]:
+        try:
+            with open(self.path) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+
+class K8sLease:
+    """coordination.k8s.io/v1 Lease over the plain k8s API.
+
+    Acquire: create (201) or take over when ``renewTime`` is older than the
+    lease duration, via PUT preconditioned on resourceVersion — a 409 means a
+    peer won the race. Renew: PUT our own record with a fresh renewTime.
+    """
+
+    def __init__(self, name: str, namespace: str = "default",
+                 identity: Optional[str] = None, lease_seconds: float = 5.0,
+                 api_base: Optional[str] = None, token: Optional[str] = None) -> None:
+        from llmd_tpu.router.discovery import K8sWatchSource
+
+        self.name = name
+        self.namespace = namespace
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:6]}"
+        self.lease_seconds = lease_seconds
+        self.api_base = api_base or K8sWatchSource._in_cluster_base()
+        self.token = token if token is not None else K8sWatchSource._in_cluster_token()
+        self._session: Optional[aiohttp.ClientSession] = None
+        self._held = False
+
+    @property
+    def _url(self) -> str:
+        return (f"{self.api_base}/apis/coordination.k8s.io/v1/namespaces/"
+                f"{self.namespace}/leases/{self.name}")
+
+    def _headers(self) -> dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def _body(self, rv: Optional[str] = None) -> dict:
+        meta: dict = {"name": self.name, "namespace": self.namespace}
+        if rv:
+            meta["resourceVersion"] = rv
+        from datetime import datetime, timezone
+
+        return {
+            "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+            "metadata": meta,
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": max(1, int(self.lease_seconds)),
+                # k8s MicroTime (RFC3339 with microseconds) — whole-second
+                # stamps would alias a fresh lease as up-to-1s stale
+                "renewTime": datetime.now(timezone.utc).strftime(
+                    "%Y-%m-%dT%H:%M:%S.%fZ"),
+            },
+        }
+
+    async def _ensure_session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def try_acquire(self) -> bool:
+        s = await self._ensure_session()
+        try:
+            async with s.get(self._url, headers=self._headers()) as r:
+                if r.status == 404:
+                    base = self._url.rsplit("/", 1)[0]
+                    async with s.post(base, headers=self._headers(),
+                                      json=self._body()) as c:
+                        self._held = c.status in (200, 201)
+                        return self._held
+                r.raise_for_status()
+                lease = await r.json()
+            spec = lease.get("spec", {})
+            holder = spec.get("holderIdentity")
+            renew = spec.get("renewTime", "1970-01-01T00:00:00.000000Z")
+            frac = float("0." + renew.split(".")[1].rstrip("Z")) if "." in renew else 0.0
+            age = time.time() - calendar.timegm(
+                time.strptime(renew.split(".")[0], "%Y-%m-%dT%H:%M:%S")) - frac
+            if holder not in (None, "", self.identity) and age < self.lease_seconds:
+                self._held = False
+                return False
+            rv = lease.get("metadata", {}).get("resourceVersion")
+            async with s.put(self._url, headers=self._headers(),
+                             json=self._body(rv)) as u:
+                self._held = u.status == 200  # 409: a peer won the race
+                return self._held
+        except aiohttp.ClientError:
+            self._held = False
+            return False
+
+    async def renew(self) -> bool:
+        return await self.try_acquire()
+
+    async def release(self) -> None:
+        if self._held:
+            s = await self._ensure_session()
+            try:
+                async with s.get(self._url, headers=self._headers()) as r:
+                    if r.status == 200:
+                        lease = await r.json()
+                        if lease.get("spec", {}).get("holderIdentity") == self.identity:
+                            lease["spec"]["holderIdentity"] = ""
+                            async with s.put(self._url, headers=self._headers(),
+                                             json=lease):
+                                pass
+            except aiohttp.ClientError:
+                pass
+        self._held = False
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+class LeaderElector:
+    """Drives a lease on an interval; flips ``is_leader`` and notifies."""
+
+    def __init__(self, lease, interval_s: float = 0.5,
+                 on_change: Optional[Callable[[bool], None]] = None) -> None:
+        self.lease = lease
+        self.interval = interval_s
+        self.on_change = on_change
+        self.is_leader = False
+        self.transitions = 0
+        self._task: Optional[asyncio.Task] = None
+
+    async def _tick(self) -> None:
+        fn = self.lease.renew if self.is_leader else self.lease.try_acquire
+        got = fn()
+        if asyncio.iscoroutine(got):
+            got = await got
+        if got != self.is_leader:
+            self.is_leader = got
+            self.transitions += 1
+            if self.on_change:
+                self.on_change(got)
+
+    async def start(self) -> None:
+        await self._tick()
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await self._tick()
+            except Exception:
+                if self.is_leader:
+                    self.is_leader = False
+                    self.transitions += 1
+                    if self.on_change:
+                        self.on_change(False)
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        rel = self.lease.release()
+        if asyncio.iscoroutine(rel):
+            await rel
+        if self.is_leader:
+            self.is_leader = False
+            self.transitions += 1
+            if self.on_change:
+                self.on_change(False)
+
+
+def attach_ha(router, elector: LeaderElector) -> None:
+    """Gate the router's generate path on leadership (active-passive mode).
+
+    Standby replicas answer 503 "standby replica" (gateway health checks and
+    retries move traffic to the leader); /metrics, /health, /v1/models keep
+    serving so the replica stays observable — /health reports the role.
+    The ext-proc front shares the same gate through admit_and_schedule.
+    Call BEFORE ``router.start()`` — route registration binds the handlers at
+    start time.
+    """
+    router.elector = elector
+    orig = router.admit_and_schedule
+
+    async def gated(req, span=None):
+        if not elector.is_leader:
+            return None, (503, "standby replica (leader election)")
+        return await orig(req, span=span)
+
+    router.admit_and_schedule = gated
+
+    async def health(request):
+        from aiohttp import web
+
+        return web.json_response({
+            "status": "ok", "endpoints": len(router.pool),
+            "role": "leader" if elector.is_leader else "standby",
+        })
+
+    router._health = health
+    router.extra_metrics.append(lambda: [
+        f"llm_d_epp_leader {1 if elector.is_leader else 0}",
+        f"llm_d_epp_leader_transitions_total {elector.transitions}",
+    ])

@@ -163,7 +163,14 @@ def test_response_phase_feeds_usage(stack):
         assert kinds == ["request_headers", "request_body", "response_headers",
                          "response_body"]
         # inflight-load producer decremented back to zero after the response
-        inflight = stack["router"].ctx.get("inflight_requests", {})
+        # (post_response is marshalled onto the router loop — allow it to land)
+        import time as _t
+
+        for _ in range(100):
+            inflight = stack["router"].ctx.get("inflight_requests", {})
+            if all(v == 0 for v in inflight.values()):
+                break
+            _t.sleep(0.02)
         assert all(v == 0 for v in inflight.values())
     finally:
         channel.close()
@@ -217,8 +224,11 @@ def test_model_rewrite_body_mutation(stack):
         resps = list(stub(_req_messages({"model": "alias", "prompt": "p",
                                          "max_tokens": 2})))
         final = resps[-1].request_body.response
-        assert final.status == pb.CommonResponse.CONTINUE_AND_REPLACE
+        # plain CONTINUE + body mutation (CONTINUE_AND_REPLACE would suppress
+        # the response phases and blind canary usage feedback)
+        assert final.status == pb.CommonResponse.CONTINUE
         assert json.loads(final.body_mutation.body)["model"] == "real-model"
+        assert HDR_DESTINATION in _set_headers(resps[-1])
     finally:
         channel.close()
         stack["router"].model_rewrites.pop("alias", None)

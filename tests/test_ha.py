@@ -1,0 +1,257 @@
+"""EPP HA: leader election (active-passive) + active-active convergence.
+
+Reference: epp/configuration.md:455-459 (leader election for replicas > 1) and
+kv-indexer.md:77-101 (active-active precise routing — every replica subscribes
+to all pods' KV events and converges on the same index, hence the same pick).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import conftest  # noqa: F401
+from conftest import run_async
+
+import aiohttp
+from aiohttp import web
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.kv.subscriber import LABEL_KV_EVENTS_ADDR
+from llmd_tpu.router import plugins as _p  # noqa: F401
+from llmd_tpu.router import scorers as _s  # noqa: F401
+from llmd_tpu.kv import plugins as _kv  # noqa: F401
+from llmd_tpu.router.ha import FileLease, K8sLease, LeaderElector, attach_ha
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.server import RouterServer
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+
+CFG = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+  - {name: inflight, type: inflight-load-producer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 2}
+"""
+
+PRECISE_CFG = """
+plugins:
+  - {name: token-producer, type: token-producer}
+  - {name: precise-producer, type: precise-prefix-cache-producer, params: {blockSize: 16}}
+  - {name: prefix, type: precise-prefix-cache-scorer}
+  - {name: queue, type: queue-depth-scorer}
+  - {name: inflight, type: inflight-load-producer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: prefix, weight: 3}
+      - {pluginRef: queue, weight: 2}
+"""
+
+
+def test_file_lease_single_holder(tmp_path):
+    a = FileLease(str(tmp_path / "lease"), identity="a")
+    b = FileLease(str(tmp_path / "lease"), identity="b")
+    assert a.try_acquire()
+    assert not b.try_acquire()
+    assert a.holder() == "a"
+    a.release()
+    assert b.try_acquire()
+    assert b.holder() == "b"
+    b.release()
+
+
+def test_active_passive_failover(tmp_path):
+    """Two full routers over one lease: exactly one serves; stopping the leader
+    moves traffic to the standby within the election interval."""
+    lease_path = str(tmp_path / "lease")
+
+    async def main():
+        fake = FakeModelServer(FakeServerConfig())
+        await fake.start()
+
+        def make_router():
+            pool = EndpointPool()
+            pool.upsert(Endpoint(address=fake.address))
+            cfg = FrameworkConfig.from_yaml(CFG, known_types=known_plugin_types())
+            return RouterServer(cfg, pool, port=0, poll_interval_s=0.1)
+
+        r1, r2 = make_router(), make_router()
+        e1 = LeaderElector(FileLease(lease_path, identity="r1"), interval_s=0.05)
+        e2 = LeaderElector(FileLease(lease_path, identity="r2"), interval_s=0.05)
+        attach_ha(r1, e1)
+        attach_ha(r2, e2)
+        await r1.start()
+        await r2.start()
+        await e1.start()
+        await e2.start()
+        assert e1.is_leader and not e2.is_leader
+
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "fake/model", "prompt": "x", "max_tokens": 2}
+            async with s.post(f"http://{r1.address}/v1/completions", json=body) as resp:
+                assert resp.status == 200
+            async with s.post(f"http://{r2.address}/v1/completions", json=body) as resp:
+                assert resp.status == 503
+                assert "standby" in (await resp.json())["error"]["message"]
+            async with s.get(f"http://{r2.address}/health") as resp:
+                assert (await resp.json())["role"] == "standby"
+
+            # leader dies → flock drops → standby takes over
+            await e1.stop()
+            for _ in range(100):
+                if e2.is_leader:
+                    break
+                await asyncio.sleep(0.02)
+            assert e2.is_leader
+            async with s.post(f"http://{r2.address}/v1/completions", json=body) as resp:
+                assert resp.status == 200
+            async with s.get(f"http://{r2.address}/metrics") as resp:
+                text = await resp.text()
+                assert "llm_d_epp_leader 1" in text
+
+        await e2.stop()
+        await r1.stop()
+        await r2.stop()
+        await fake.stop()
+
+    run_async(main())
+
+
+def test_active_active_convergence():
+    """Two replicas, no leader election, both subscribing to all pods' KV
+    events (pod-discovery): after traffic through replica A, replica B's index
+    has converged and BOTH pick the same endpoint for a shared-prefix request —
+    the kv-indexer.md active-active contract."""
+
+    async def main():
+        fakes = [FakeModelServer(FakeServerConfig(
+            kv_events_port=0, prefill_us_per_token=5.0, decode_us_per_token=5.0,
+        )) for _ in range(3)]
+        for f in fakes:
+            await f.start()
+
+        def make_router():
+            pool = EndpointPool()
+            for f in fakes:
+                pool.upsert(Endpoint(
+                    address=f.address,
+                    labels={LABEL_KV_EVENTS_ADDR: f"127.0.0.1:{f.cfg.kv_events_port}"},
+                ))
+            cfg = FrameworkConfig.from_yaml(PRECISE_CFG,
+                                            known_types=known_plugin_types())
+            return RouterServer(cfg, pool, port=0, poll_interval_s=0.1)
+
+        ra, rb = make_router(), make_router()
+        await ra.start()
+        await rb.start()
+        assert ra.kv_subscriber is not None and rb.kv_subscriber is not None
+        await asyncio.sleep(0.3)  # SUB slow joiner
+
+        prefix = "converging shared prefix " * 10
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://{ra.address}/v1/completions",
+                              json={"model": "fake/model", "prompt": prefix + "q0",
+                                    "max_tokens": 4}) as r:
+                assert r.status == 200
+                first = r.headers["x-llm-d-endpoint"]
+            # both replicas' indexes converge from the same pod event streams
+            for _ in range(100):
+                if len(ra.ctx["kv_index"]) and len(rb.ctx["kv_index"]):
+                    break
+                await asyncio.sleep(0.02)
+            assert len(rb.ctx["kv_index"]) > 0, "replica B must see pod events too"
+
+            picks = set()
+            for router in (ra, rb):
+                async with s.post(f"http://{router.address}/v1/completions",
+                                  json={"model": "fake/model",
+                                        "prompt": prefix + "q-next",
+                                        "max_tokens": 4}) as r:
+                    assert r.status == 200
+                    picks.add(r.headers["x-llm-d-endpoint"])
+        assert picks == {first}, (
+            f"replicas diverged: A/B picked {picks}, traffic went to {first}")
+
+        await ra.stop()
+        await rb.stop()
+        for f in fakes:
+            await f.stop()
+
+    run_async(main())
+
+
+class FakeLeaseAPI:
+    """coordination.k8s.io Lease subset with resourceVersion conflicts."""
+
+    def __init__(self) -> None:
+        self.lease = None
+        self.rv = 0
+        self._runner = None
+        self.port = 0
+        self.conflicts = 0
+
+    async def start(self):
+        app = web.Application()
+        app.router.add_route("*", "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases", self._col)
+        app.router.add_route("*", "/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/{name}", self._item)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+    async def _col(self, request: web.Request):
+        if request.method == "POST":
+            if self.lease is not None:
+                return web.json_response({}, status=409)
+            self.lease = await request.json()
+            self.rv += 1
+            self.lease["metadata"]["resourceVersion"] = str(self.rv)
+            return web.json_response(self.lease, status=201)
+        return web.json_response({}, status=405)
+
+    async def _item(self, request: web.Request):
+        if request.method == "GET":
+            if self.lease is None:
+                return web.json_response({}, status=404)
+            return web.json_response(self.lease)
+        if request.method == "PUT":
+            body = await request.json()
+            want = body.get("metadata", {}).get("resourceVersion")
+            have = self.lease["metadata"]["resourceVersion"] if self.lease else None
+            if self.lease is not None and want != have:
+                self.conflicts += 1
+                return web.json_response({}, status=409)
+            self.rv += 1
+            body["metadata"]["resourceVersion"] = str(self.rv)
+            self.lease = body
+            return web.json_response(body)
+        return web.json_response({}, status=405)
+
+
+def test_k8s_lease_acquire_renew_takeover():
+    async def main():
+        api = FakeLeaseAPI()
+        await api.start()
+        base = f"http://127.0.0.1:{api.port}"
+        a = K8sLease("epp", identity="a", lease_seconds=0.3, api_base=base, token="t")
+        b = K8sLease("epp", identity="b", lease_seconds=0.3, api_base=base, token="t")
+        assert await a.try_acquire()
+        assert not await b.try_acquire()  # fresh lease held by a
+        assert await a.renew()
+        # a stops renewing; after lease_seconds b takes over
+        await asyncio.sleep(0.5)
+        assert await b.try_acquire()
+        assert api.lease["spec"]["holderIdentity"] == "b"
+        await a.release()
+        await b.release()
+        await api.stop()
+
+    run_async(main())

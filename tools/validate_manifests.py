@@ -1,0 +1,188 @@
+"""Hardware-free manifest validation — the ci-kustomize-dry-run analogue.
+
+The reference validates every guide's manifests in CI without hardware
+(/root/reference/.github/workflows/ci-kustomize-dry-run.yaml:22-60, including
+the simulated-accelerators filter). This validator does the same for
+deploy/*/manifests.yaml, plus checks a kustomize dry-run can't do — it knows
+our binaries:
+
+1. k8s object shape (apiVersion/kind/metadata.name; Deployment selector must
+   match template labels; probe contract: /health liveness + /v1/models
+   readiness on engine containers).
+2. our CRDs parse + validate through llmd_tpu.core.crds (targetPorts ≤ 8,
+   failureMode, cross-references).
+3. **container args resolve against the real argparse surface** of the named
+   module (llmd_tpu.engine.serve / router.serve / disagg.sidecar) — a renamed
+   CLI flag fails validation instead of CrashLoopBackOff at deploy time.
+4. port consistency: InferencePool targetPorts ⊆ some pod's containerPorts;
+   probe ports declared.
+
+Usage: python tools/validate_manifests.py [deploy/]   (exit 0 = valid)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import yaml
+
+from llmd_tpu.core.crds import ManifestError, load_manifests
+
+ENTRYPOINT_FLAGS: dict[str, set[str]] = {}
+
+
+def _argparse_flags(module: str) -> set[str]:
+    """Extract the real --flag surface of a CLI module without executing it."""
+    if module in ENTRYPOINT_FLAGS:
+        return ENTRYPOINT_FLAGS[module]
+    import ast
+    import importlib.util
+
+    spec = importlib.util.find_spec(module)
+    flags: set[str] = set()
+    tree = ast.parse(open(spec.origin).read())
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument"):
+            for arg in node.args:
+                if isinstance(arg, ast.Constant) and str(arg.value).startswith("--"):
+                    flags.add(str(arg.value))
+    ENTRYPOINT_FLAGS[module] = flags
+    return flags
+
+
+class Issues:
+    def __init__(self) -> None:
+        self.errors: list[str] = []
+
+    def err(self, path: str, msg: str) -> None:
+        self.errors.append(f"{path}: {msg}")
+
+
+def _containers(doc: dict) -> list[dict]:
+    return (doc.get("spec", {}).get("template", {}).get("spec", {})
+            .get("containers", []))
+
+
+def _validate_deployment(path: str, doc: dict, iss: Issues) -> None:
+    name = doc.get("metadata", {}).get("name", "?")
+    spec = doc.get("spec", {})
+    sel = spec.get("selector", {}).get("matchLabels", {})
+    tmpl_labels = spec.get("template", {}).get("metadata", {}).get("labels", {})
+    if not sel:
+        iss.err(path, f"Deployment {name}: missing selector.matchLabels")
+    for k, v in sel.items():
+        if tmpl_labels.get(k) != v:
+            iss.err(path, f"Deployment {name}: selector {k}={v} not in template labels")
+    cs = _containers(doc)
+    if not cs:
+        iss.err(path, f"Deployment {name}: no containers")
+    for c in cs:
+        _validate_container(path, name, c, iss)
+
+
+def _validate_container(path: str, dep: str, c: dict, iss: Issues) -> None:
+    args = [str(a) for a in c.get("args", [])]
+    ports = {p.get("containerPort") for p in c.get("ports", [])}
+    # module invocation: python -m <module> --flags...
+    if "-m" in args:
+        module = args[args.index("-m") + 1]
+        try:
+            known = _argparse_flags(module)
+        except Exception as e:
+            iss.err(path, f"{dep}/{c.get('name')}: module {module!r} not importable: {e}")
+            return
+        for a in args:
+            if a.startswith("--") and a not in known:
+                iss.err(path, f"{dep}/{c.get('name')}: unknown flag {a} for {module} "
+                              f"(has: {', '.join(sorted(known))})")
+        # declared serving port should match a --port arg when present
+        if "--port" in args:
+            try:
+                port = int(args[args.index("--port") + 1])
+                if ports and port not in ports:
+                    iss.err(path, f"{dep}/{c.get('name')}: --port {port} not in "
+                                  f"containerPorts {sorted(p for p in ports if p)}")
+            except (ValueError, IndexError):
+                iss.err(path, f"{dep}/{c.get('name')}: malformed --port arg")
+    for probe in ("livenessProbe", "readinessProbe"):
+        pr = c.get(probe)
+        if pr and "httpGet" in pr:
+            pport = pr["httpGet"].get("port")
+            if ports and pport not in ports:
+                iss.err(path, f"{dep}/{c.get('name')}: {probe} port {pport} "
+                              f"not declared in containerPorts")
+
+
+def _validate_file(path: str, iss: Issues) -> None:
+    with open(path) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    crd_docs, deployments, pod_ports = [], [], set()
+    for doc in docs:
+        kind = doc.get("kind")
+        if not kind or not doc.get("metadata", {}).get("name"):
+            iss.err(path, f"document missing kind/metadata.name: {str(doc)[:80]}")
+            continue
+        if kind in ("InferencePool", "InferenceObjective", "InferenceModelRewrite",
+                    "VariantAutoscaling"):
+            crd_docs.append(doc)
+        elif kind == "Deployment":
+            deployments.append(doc)
+            _validate_deployment(path, doc, iss)
+            for c in _containers(doc):
+                pod_ports |= {p.get("containerPort") for p in c.get("ports", [])}
+        elif kind in ("Service", "ConfigMap", "Namespace"):
+            pass
+        else:
+            iss.err(path, f"unexpected kind {kind!r}")
+    try:
+        ms = load_manifests(crd_docs)
+    except ManifestError as e:
+        iss.err(path, f"CRD validation: {e}")
+        return
+    for pool in ms.pools:
+        for port in pool.target_ports:
+            if pod_ports and port not in pod_ports:
+                iss.err(path, f"InferencePool {pool.name}: targetPort {port} not "
+                              f"exposed by any container")
+        # the selector must select at least one Deployment's template labels
+        matched = any(
+            all(d.get("spec", {}).get("template", {}).get("metadata", {})
+                .get("labels", {}).get(k) == v for k, v in pool.selector.items())
+            for d in deployments
+        )
+        if deployments and not matched:
+            iss.err(path, f"InferencePool {pool.name}: selector {pool.selector} "
+                          f"matches no Deployment template")
+
+
+def validate(root: str) -> list[str]:
+    iss = Issues()
+    files = sorted(glob.glob(os.path.join(root, "**", "*.yaml"), recursive=True))
+    if not files:
+        iss.err(root, "no manifest files found")
+    for path in files:
+        _validate_file(path, iss)
+    return iss.errors
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("root", nargs="?", default="deploy")
+    args = ap.parse_args()
+    errors = validate(args.root)
+    if errors:
+        for e in errors:
+            print(f"ERROR {e}", file=sys.stderr)
+        raise SystemExit(1)
+    n = len(glob.glob(os.path.join(args.root, "**", "*.yaml"), recursive=True))
+    print(f"OK: {n} manifest files valid under {args.root}/")
+
+
+if __name__ == "__main__":
+    main()
