@@ -1,0 +1,10 @@
+"""Benchmark harness: workload profiles, load generation, RR-vs-scheduler
+comparison (the reference's `llmdbenchmark` / inference-perf role)."""
+
+from llmd_tpu.benchmark.harness import (  # noqa: F401
+    LoadResult,
+    WorkloadSpec,
+    build_requests,
+    compare_targets,
+    run_load,
+)

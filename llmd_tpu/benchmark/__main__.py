@@ -1,0 +1,3 @@
+from llmd_tpu.benchmark.harness import main
+
+main()

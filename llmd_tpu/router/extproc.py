@@ -182,11 +182,8 @@ class ExtProcEPP:
         except Exception as e:  # EPP-internal failure → failureMode applies
             return self._fail(st, phase, 500, f"EPP error: {e}")
         if err is not None:
-            status, message = err
-            # flow-control outcomes are deliberate shedding; "no endpoint" is
-            # an EPP-can't-answer condition the failureMode may pass through
-            return self._fail(st, phase, status, message,
-                              deliberate=message.startswith("flow control"))
+            return self._fail(st, phase, err.status, err.message,
+                              deliberate=err.deliberate)
         st.endpoint = result.endpoint
         self.metrics["picks_total"] += 1
 

@@ -18,8 +18,10 @@ docs/architecture/advanced/kv-management/kv-indexer.md:77-101):
   property across two full RouterServers.
 
 ``attach_ha`` wires an elector into a RouterServer: standby replicas answer
-generate requests with 503 + ``x-llm-d-standby`` (the gateway's health checks
-move traffic to the leader) while /metrics & /health keep serving.
+generate requests 503 "standby replica" (the gateway's health checks and
+retries move traffic to the leader; /health reports the role) while /metrics
+keeps serving. The deployment CLI enables it with ``--ha-lease-file PATH``
+(co-located processes) or ``--ha-k8s-lease NAME`` (in-cluster).
 """
 
 from __future__ import annotations
@@ -147,9 +149,15 @@ class K8sLease:
             spec = lease.get("spec", {})
             holder = spec.get("holderIdentity")
             renew = spec.get("renewTime", "1970-01-01T00:00:00.000000Z")
-            frac = float("0." + renew.split(".")[1].rstrip("Z")) if "." in renew else 0.0
-            age = time.time() - calendar.timegm(
-                time.strptime(renew.split(".")[0], "%Y-%m-%dT%H:%M:%S")) - frac
+            try:
+                # tolerate both MicroTime and second-precision RFC3339 ('...Z')
+                whole = renew.split(".")[0].rstrip("Z")
+                frac = (float("0." + renew.split(".")[1].rstrip("Z"))
+                        if "." in renew else 0.0)
+                age = time.time() - calendar.timegm(
+                    time.strptime(whole, "%Y-%m-%dT%H:%M:%S")) - frac
+            except (ValueError, IndexError):
+                age = float("inf")  # unparseable renewTime = stale, takeover OK
             if holder not in (None, "", self.identity) and age < self.lease_seconds:
                 self._held = False
                 return False
@@ -255,7 +263,11 @@ def attach_ha(router, elector: LeaderElector) -> None:
 
     async def gated(req, span=None):
         if not elector.is_leader:
-            return None, (503, "standby replica (leader election)")
+            from llmd_tpu.router.server import Rejection
+
+            # deliberate: a FailOpen gateway must not bypass the leader gate
+            return None, Rejection(503, "standby replica (leader election)",
+                                   deliberate=True)
         return await orig(req, span=span)
 
     router.admit_and_schedule = gated

@@ -51,7 +51,15 @@ def main() -> None:
     ap.add_argument("--k8s-discovery", action="store_true",
                     help="discover endpoints by watching pods matching the "
                          "manifest InferencePool's selector/targetPorts")
+    ap.add_argument("--ha-lease-file", default=None,
+                    help="active-passive leader election via a local flock "
+                         "lease (co-located replicas)")
+    ap.add_argument("--ha-k8s-lease", default=None,
+                    help="active-passive leader election via a "
+                         "coordination.k8s.io Lease of this name")
     args = ap.parse_args()
+    if args.ha_lease_file and args.ha_k8s_lease:
+        raise SystemExit("--ha-lease-file and --ha-k8s-lease are exclusive")
 
     from llmd_tpu.core.config import FrameworkConfig
     from llmd_tpu.core.endpoint import EndpointPool
@@ -103,8 +111,19 @@ def main() -> None:
         model_rewrites=manifests.rewrites_map() if manifests else None,
     )
 
+    elector = None
+    if args.ha_lease_file or args.ha_k8s_lease:
+        from llmd_tpu.router.ha import FileLease, K8sLease, LeaderElector, attach_ha
+
+        lease = (FileLease(args.ha_lease_file) if args.ha_lease_file
+                 else K8sLease(args.ha_k8s_lease))
+        elector = LeaderElector(lease)
+        attach_ha(server, elector)  # before start(): handlers bind at start
+
     async def run() -> None:
         await server.start()
+        if elector is not None:
+            await elector.start()
         for src in sources:
             await src.start()
         discovery = (f"{len(pool)} endpoints"
@@ -125,6 +144,8 @@ def main() -> None:
                              failure_mode=failure_mode)
             await epp.start()
             msg += f"; ext-proc EPP on grpc://{epp.address} ({failure_mode})"
+        if elector is not None:
+            msg += f"; HA role={'leader' if elector.is_leader else 'standby'}"
         print(msg, flush=True)
         await asyncio.Event().wait()
 

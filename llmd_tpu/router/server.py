@@ -17,6 +17,7 @@ import asyncio
 import json
 import time
 import uuid
+from dataclasses import dataclass
 from typing import Any, Optional
 
 import aiohttp
@@ -36,6 +37,17 @@ from llmd_tpu.router.scheduler import Scheduler
 from llmd_tpu.router.scorers import STATE_TOKEN_IDS
 
 GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/embeddings")
+
+
+@dataclass
+class Rejection:
+    """A non-dispatch admission outcome (admit_and_schedule error channel)."""
+
+    status: int
+    message: str
+    # True = an enforced decision (shedding, standby gate) that FailOpen
+    # gateways must still honour; False = the EPP couldn't answer.
+    deliberate: bool = False
 
 
 def parse_openai_request(path: str, body: dict, headers: dict[str, str]) -> InferenceRequest:
@@ -207,15 +219,20 @@ class RouterServer:
     async def admit_and_schedule(self, req: InferenceRequest, span=None):
         """Flow-control gate → async producers → scheduler pick.
 
-        Returns (result, None) on success or (None, (http_status, message)) on
-        rejection — one admission semantics for both serving fronts."""
+        Returns (result, None) on success or (None, Rejection) — one admission
+        semantics for both serving fronts. ``Rejection.deliberate`` marks
+        enforced admission decisions (load shedding, standby gating) that a
+        FailOpen gateway must NOT bypass, vs EPP-can't-answer conditions
+        (no endpoint) that failureMode may pass through."""
         if self.flow:
             if span:
                 span.add_event("flow_control.enqueue")
             outcome = await self.flow.enqueue_and_wait(req)
             if outcome is not RequestOutcome.DISPATCHED:
                 self.metrics["errors_total"] += 1
-                return None, (outcome.http_status, f"flow control: {outcome.value}")
+                return None, Rejection(outcome.http_status,
+                                       f"flow control: {outcome.value}",
+                                       deliberate=True)
         for p in self._async_producers:
             await p.aproduce(req, self.pool.list(), self._session)
         if span:
@@ -225,7 +242,7 @@ class RouterServer:
         )
         if result.endpoint is None:
             self.metrics["errors_total"] += 1
-            return None, (503, f"no endpoint: {result.rejected}")
+            return None, Rejection(503, f"no endpoint: {result.rejected}")
         return result, None
 
     async def _handle_generate(self, request: web.Request):
@@ -247,10 +264,10 @@ class RouterServer:
 
         result, err = await self.admit_and_schedule(req, span=span)
         if err is not None:
-            status, message = err
-            span.set_error(message)
+            span.set_error(err.message)
             span.end()
-            return web.json_response({"error": {"message": message}}, status=status)
+            return web.json_response({"error": {"message": err.message}},
+                                     status=err.status)
         span.set_attribute("llm_d.endpoint", result.endpoint.address)
         span.add_event("proxy.forward")
 

@@ -89,27 +89,39 @@ def _validate_deployment(path: str, doc: dict, iss: Issues) -> None:
 def _validate_container(path: str, dep: str, c: dict, iss: Issues) -> None:
     args = [str(a) for a in c.get("args", [])]
     ports = {p.get("containerPort") for p in c.get("ports", [])}
-    # module invocation: python -m <module> --flags...
-    if "-m" in args:
+    # module invocation: python -m <module> --flags... (both "--flag value"
+    # and "--flag=value" are legal k8s args)
+    if "-m" in args and args.index("-m") + 1 < len(args):
         module = args[args.index("-m") + 1]
         try:
             known = _argparse_flags(module)
         except Exception as e:
             iss.err(path, f"{dep}/{c.get('name')}: module {module!r} not importable: {e}")
             return
-        for a in args:
-            if a.startswith("--") and a not in known:
-                iss.err(path, f"{dep}/{c.get('name')}: unknown flag {a} for {module} "
-                              f"(has: {', '.join(sorted(known))})")
-        # declared serving port should match a --port arg when present
-        if "--port" in args:
+        flag_value: dict[str, str] = {}
+        toks = args[args.index("-m") + 2:]
+        for i, a in enumerate(toks):
+            if not a.startswith("--"):
+                continue
+            name, eq, val = a.partition("=")
+            if not eq and i + 1 < len(toks) and not toks[i + 1].startswith("--"):
+                val = toks[i + 1]
+            if name not in known:
+                iss.err(path, f"{dep}/{c.get('name')}: unknown flag {name} for "
+                              f"{module} (has: {', '.join(sorted(known))})")
+            else:
+                flag_value[name] = val
+        # declared serving port should match the --port arg when present
+        if "--port" in flag_value:
             try:
-                port = int(args[args.index("--port") + 1])
+                port = int(flag_value["--port"])
                 if ports and port not in ports:
                     iss.err(path, f"{dep}/{c.get('name')}: --port {port} not in "
                                   f"containerPorts {sorted(p for p in ports if p)}")
-            except (ValueError, IndexError):
+            except ValueError:
                 iss.err(path, f"{dep}/{c.get('name')}: malformed --port arg")
+    elif "-m" in args:
+        iss.err(path, f"{dep}/{c.get('name')}: dangling -m with no module")
     for probe in ("livenessProbe", "readinessProbe"):
         pr = c.get(probe)
         if pr and "httpGet" in pr:
