@@ -70,7 +70,12 @@ def build_plugin(type_name: str, params: dict[str, Any], ctx: Optional[dict[str,
     if cls is None:
         raise KeyError(f"unknown plugin type {type_name!r}; known: {sorted(PLUGIN_REGISTRY)}")
     if getattr(cls, "needs_ctx", False):
-        return cls(ctx or {}, **params)
+        # NOT `ctx or {}`: the shared context is an EMPTY dict at construction
+        # time, which is falsy — that would hand every plugin a private fresh
+        # dict and silently break all cross-component ctx sharing (the KV-event
+        # subscriber feeding an index no scorer reads, inflight counts no
+        # flow-controller sees).
+        return cls(ctx if ctx is not None else {}, **params)
     return cls(**params)
 
 

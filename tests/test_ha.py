@@ -158,12 +158,20 @@ def test_active_active_convergence():
                                     "max_tokens": 4}) as r:
                 assert r.status == 200
                 first = r.headers["x-llm-d-endpoint"]
-            # both replicas' indexes converge from the same pod event streams
-            for _ in range(100):
-                if len(ra.ctx["kv_index"]) and len(rb.ctx["kv_index"]):
+            # both replicas' indexes converge from the same pod event streams.
+            # Events stream in batches as prefill progresses — "non-empty" is
+            # not convergence; wait until both counts are EQUAL and STABLE
+            # across consecutive polls (the stream has drained into both).
+            prev = (-1, -2)
+            for _ in range(300):
+                cur = (len(ra.ctx["kv_index"]), len(rb.ctx["kv_index"]))
+                if cur[0] > 0 and cur[0] == cur[1] and cur == prev:
                     break
-                await asyncio.sleep(0.02)
+                prev = cur
+                await asyncio.sleep(0.05)
             assert len(rb.ctx["kv_index"]) > 0, "replica B must see pod events too"
+            assert len(ra.ctx["kv_index"]) == len(rb.ctx["kv_index"]), (
+                "replica indexes did not converge from the shared event streams")
 
             picks = set()
             for router in (ra, rb):
