@@ -106,11 +106,13 @@ def main() -> None:
         eng_cfg.max_num_batched_tokens = max(eng_cfg.batched_tokens, args.batch * 8)
     if args.decode_steps:
         eng_cfg.decode_steps = args.decode_steps
-    # +decode_steps: the fused-decode path pre-allocates k-1 lookahead slots per
-    # sequence; undersizing silently degrades every step to the unified fallback
-    pages_per_seq = (isl + osl + eng_cfg.decode_steps) // eng_cfg.page_size + 1
+    # +decode_steps*(depth+1): the pipelined fused-decode path pre-allocates
+    # lookahead slots for every in-flight call; undersizing silently degrades
+    # every step to the unified fallback
+    lookahead = eng_cfg.decode_steps * (eng_cfg.pipeline_depth + 1)
+    pages_per_seq = (isl + osl + lookahead) // eng_cfg.page_size + 1
     eng_cfg.num_pages = max(eng_cfg.num_pages, n_req * pages_per_seq + 64)
-    eng_cfg.max_model_len = max(eng_cfg.max_model_len, isl + osl + eng_cfg.decode_steps + 1)
+    eng_cfg.max_model_len = max(eng_cfg.max_model_len, isl + osl + lookahead + 1)
 
     # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
     # the latency the pipelined decode path exists to hide

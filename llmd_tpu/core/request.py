@@ -31,13 +31,19 @@ def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
     prompts with different images never share cache entries."""
     import hashlib
 
-    if part.get("type") == "image_url":
+    kind = part.get("type")
+    if kind == "image_url":
         url = (part.get("image_url") or {}).get("url", "")
-        return hashlib.sha256(url.encode()).digest() if url else None
-    if part.get("type") == "input_audio":
-        data = (part.get("input_audio") or {}).get("data", "")
-        return hashlib.sha256(data.encode()).digest() if data else None
-    return None
+    elif kind in ("input_audio", "video_url", "audio_url"):
+        sub = part.get(kind) or {}
+        url = sub.get("url", "") or sub.get("data", "")
+    else:
+        return None
+    if not url:
+        return None
+    # kind folds in: the same bytes as image vs video are different cache
+    # identities (modality-specific encoders produce different embeddings)
+    return hashlib.sha256(f"{kind}:".encode() + str(url).encode()).digest()
 
 
 def flatten_messages(messages: Sequence[dict[str, Any]]) -> str:

@@ -1,0 +1,219 @@
+"""Encode worker: the E stage of E/PD and E/P/D multimodal disaggregation.
+
+The reference offloads multimodal encoding (media → embeddings) to dedicated
+workers; multiple entries in one request encode concurrently on different
+workers, and the resulting embeddings are consumed by prefill/decode alongside
+text tokens (`guides/multimodal-serving/e-disaggregation/README.md`).
+
+TPU shape of the same idea:
+- one jitted vision-tower program (models/vision.py) batched over the media
+  items of a request — N items compile once and ride the MXU together;
+- a stateless HTTP worker (`POST /v1/encode`) returning
+  ``{items: [{mm_hash, n_tokens, embedding_b64}]}``; the sidecar fans request
+  media out across workers and attaches the rows as ``mm_items`` for the P/D
+  engines (engine-side injection: models/transformer.forward_core mm path);
+- a content-hash LRU so re-sent media (multi-turn chats re-uploading the same
+  image) skip the tower entirely — the encode analogue of prefix caching.
+
+Vision params are derived deterministically from the model name, so every
+encode worker for a model produces identical embeddings — interchangeable
+workers, exactly like the reference's encode pool.
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import hashlib
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+from aiohttp import web
+
+from llmd_tpu.models.config import ModelConfig
+from llmd_tpu.models.vision import (
+    bytes_to_pixels,
+    encode_images,
+    init_vision_params,
+    mm_content_hash,
+)
+
+
+def is_media_part(part) -> bool:
+    """Cheap media detection: inline ``data:`` URI of a known kind. Does NOT
+    decode the payload — detection runs on event loops where materializing a
+    64 MB base64 body would stall every concurrent stream."""
+    if not isinstance(part, dict):
+        return False
+    kind = part.get("type")
+    if kind == "image_url":
+        url = (part.get("image_url") or {}).get("url", "")
+    elif kind in ("input_audio", "video_url", "audio_url"):
+        sub = part.get(kind) or {}
+        url = sub.get("url", "") or sub.get("data", "")
+    else:
+        return False
+    return isinstance(url, str) and url.startswith("data:")
+
+
+def part_identity(part: dict) -> bytes:
+    """Canonical media identity used EVERYWHERE a media hash is compared:
+    router-side block keys (core/request._mm_hash over the URI string), the
+    encode wire format, engine block-key folds, and P/D transfer. One function
+    or prefix-cache affinity silently breaks for every multimodal request."""
+    from llmd_tpu.core.request import _mm_hash
+
+    h = _mm_hash(part)
+    return h if h is not None else hashlib.sha256(b"media").digest()
+
+
+def iter_media_parts(body: dict):
+    """Yield the media content parts of an OpenAI-style request body, in prompt
+    order — the ONE traversal shared by the sidecar's E-stage fan-out and the
+    engine server's VL detection/tokenization (they must agree on what counts
+    as media or E/PD and combined-PD diverge)."""
+    for m in body.get("messages", []) or []:
+        content = m.get("content")
+        if isinstance(content, list):
+            for part in content:
+                if is_media_part(part):
+                    yield part
+
+
+def media_bytes_from_part(part: dict) -> Optional[bytes]:
+    """OpenAI-style content part → raw media bytes (data: URIs only — this
+    environment has no egress; remote URLs are the caller's job to inline)."""
+    if not isinstance(part, dict):
+        return None
+    kind = part.get("type")
+    if kind == "image_url":
+        url = (part.get("image_url") or {}).get("url", "")
+    elif kind in ("input_audio", "video_url", "audio_url"):
+        url = (part.get(kind) or {}).get("url", "") or (part.get(kind) or {}).get("data", "")
+    else:
+        return None
+    if isinstance(url, str) and url.startswith("data:"):
+        try:
+            return base64.b64decode(url.split(",", 1)[1], validate=False)
+        except (IndexError, binascii.Error):
+            return None
+    return None
+
+
+class VisionRunner:
+    """Jitted vision tower + content-hash LRU (shared by encode workers and
+    combined-PD servers that encode in-process)."""
+
+    def __init__(self, cfg: ModelConfig, cache_items: int = 256) -> None:
+        import jax
+
+        if not cfg.has_vision:
+            raise ValueError(f"model {cfg.name!r} has no vision tower")
+        self.cfg = cfg
+        seed = int.from_bytes(
+            hashlib.sha256(f"vision:{cfg.name}".encode()).digest()[:4], "little")
+        self.params = init_vision_params(cfg, jax.random.PRNGKey(seed))
+        self._fn = jax.jit(lambda px: encode_images(cfg, self.params, px))
+        self._lru: OrderedDict[bytes, np.ndarray] = OrderedDict()
+        self._cache_items = cache_items
+        self.stats = {"encoded_items": 0, "cache_hits": 0}
+
+    def encode(self, payloads: list[bytes]) -> list[tuple[bytes, np.ndarray]]:
+        """bytes per media item → [(content_hash, [mm_tokens, hidden] f32)]."""
+        out: list[Optional[tuple[bytes, np.ndarray]]] = [None] * len(payloads)
+        fresh: list[tuple[int, bytes, bytes]] = []  # (slot, hash, payload)
+        for i, data in enumerate(payloads):
+            h = mm_content_hash(data)
+            hit = self._lru.get(h)
+            if hit is not None:
+                self._lru.move_to_end(h)
+                self.stats["cache_hits"] += 1
+                out[i] = (h, hit)
+            else:
+                fresh.append((i, h, data))
+        if fresh:
+            px = np.stack([bytes_to_pixels(self.cfg, d) for _, _, d in fresh])
+            emb = np.asarray(self._fn(px), np.float32)  # [n, mm_tokens, hidden]
+            for (i, h, _), e in zip(fresh, emb):
+                out[i] = (h, e)
+                self._lru[h] = e
+                if len(self._lru) > self._cache_items:
+                    self._lru.popitem(last=False)
+            self.stats["encoded_items"] += len(fresh)
+        return out  # type: ignore[return-value]
+
+
+def mm_item_to_wire(h: bytes, emb: np.ndarray) -> dict:
+    return {
+        "mm_hash": h.hex(),
+        "n_tokens": int(emb.shape[0]),
+        "embedding_b64": base64.b64encode(
+            np.ascontiguousarray(emb, np.float32).tobytes()).decode(),
+    }
+
+
+def mm_item_from_wire(d: dict, hidden_size: int) -> tuple[bytes, np.ndarray]:
+    emb = np.frombuffer(base64.b64decode(d["embedding_b64"]), np.float32)
+    return bytes.fromhex(d["mm_hash"]), emb.reshape(int(d["n_tokens"]), hidden_size)
+
+
+class EncodeServer:
+    """Standalone encode worker (the reference's encode-deployment.yaml role)."""
+
+    def __init__(self, cfg: ModelConfig, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.cfg = cfg
+        self.host, self.port = host, port
+        self.runner_ = VisionRunner(cfg)
+        self._runner: Optional[web.AppRunner] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        app = web.Application(client_max_size=64 * 1024 * 1024)
+        app.router.add_post("/v1/encode", self._encode)
+        app.router.add_get("/health", self._health)
+        app.router.add_get("/metrics", self._metrics)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    async def _health(self, request: web.Request):
+        return web.json_response({"status": "ok", "role": "encode"})
+
+    async def _metrics(self, request: web.Request):
+        s = self.runner_.stats
+        body = (
+            f'llmd_tpu:encode_items_total {s["encoded_items"]}\n'
+            f'llmd_tpu:encode_cache_hits_total {s["cache_hits"]}\n'
+        )
+        return web.Response(text=body, content_type="text/plain")
+
+    async def _encode(self, request: web.Request):
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        parts = body.get("items", [])
+        payloads: list[bytes] = []
+        for part in parts:
+            data = media_bytes_from_part(part)
+            if data is None:
+                return web.json_response(
+                    {"error": "unsupported media part (inline data: URIs only)"},
+                    status=400)
+            payloads.append(data)
+        encoded = self.runner_.encode(payloads)
+        # wire identity = the canonical part hash (what router + engine fold
+        # into block keys); the runner's content-hash only keys its own LRU
+        return web.json_response(
+            {"items": [mm_item_to_wire(part_identity(p), e)
+                       for p, (_h, e) in zip(parts, encoded)]})

@@ -12,6 +12,13 @@ reads the router's ``x-prefiller-host-port`` header, and:
    local decode engine, streaming the response straight through,
 4. falls back to decoder-only (aggregated) when the prefiller fails with 5xx or is
    unreachable (README.md:130).
+
+E/PD and E/P/D (encode disaggregation, guides/multimodal-serving/e-disaggregation/
+README.md): with ``encode_hosts`` configured, requests carrying media content
+parts first fan those parts out across the encode workers — one worker per item,
+concurrently, round-robin — and attach the returned embedding rows as
+``mm_items`` before the normal (P→)D flow. Text-only requests skip the E stage
+entirely, exactly as the reference specifies.
 """
 
 from __future__ import annotations
@@ -37,14 +44,21 @@ class RoutingSidecar:
         port: int = 0,
         enable_prefiller_sampling: bool = False,
         prefill_timeout_s: float = 120.0,
+        encode_hosts: Optional[list[str]] = None,
+        encode_timeout_s: float = 60.0,
     ) -> None:
         self.decode_addr = decode_addr
         self.host, self.port = host, port
         self.enable_prefiller_sampling = enable_prefiller_sampling
         self.prefill_timeout = aiohttp.ClientTimeout(total=prefill_timeout_s)
+        self.encode_hosts = list(encode_hosts or [])
+        self.encode_timeout = aiohttp.ClientTimeout(total=encode_timeout_s)
+        self._encode_rr = 0  # round-robin cursor over the encode pool
         self._runner: Optional[web.AppRunner] = None
         self._session: Optional[aiohttp.ClientSession] = None
-        self.stats = {"pd_requests": 0, "aggregated_requests": 0, "prefill_fallbacks": 0}
+        self.stats = {"pd_requests": 0, "aggregated_requests": 0,
+                      "prefill_fallbacks": 0, "encoded_items": 0,
+                      "encode_failures": 0}
 
     @property
     def address(self) -> str:
@@ -75,6 +89,9 @@ class RoutingSidecar:
         except Exception:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
 
+        if self.encode_hosts:
+            body = await self._run_encode(body)
+
         prefiller = request.headers.get(HDR_PREFILLER_HOST_PORT)
         if prefiller:
             ktp = await self._run_prefill(request.path, body, prefiller)
@@ -87,6 +104,48 @@ class RoutingSidecar:
         else:
             self.stats["aggregated_requests"] += 1
         return await self._forward_decode(request, body)
+
+    async def _run_encode(self, body: dict) -> dict:
+        """E stage: fan media parts out across the encode pool, one item per
+        worker concurrently (the reference's parallelized-across-entries
+        property); attach the rows as mm_items. Failures leave the request
+        un-annotated — the engine then serves text-only placeholders rather
+        than 500ing the whole request (encode is best-effort like prefill)."""
+        from llmd_tpu.disagg.encode import iter_media_parts
+
+        parts = list(iter_media_parts(body))
+        if not parts or body.get("mm_items"):
+            return body  # text-only, or already encoded upstream
+
+        async def one(part: dict) -> Optional[dict]:
+            # try up to two distinct workers (round-robin) before giving up on
+            # an item; a failed item costs only ITS OWN encode downstream — the
+            # successes still attach (partial results beat discarding work)
+            for _ in range(min(2, len(self.encode_hosts))):
+                host = self.encode_hosts[self._encode_rr % len(self.encode_hosts)]
+                self._encode_rr += 1
+                try:
+                    async with self._session.post(
+                        f"http://{host}/v1/encode", json={"items": [part]},
+                        timeout=self.encode_timeout,
+                    ) as resp:
+                        if resp.status != 200:
+                            continue
+                        return (await resp.json())["items"][0]
+                except (aiohttp.ClientError, asyncio.TimeoutError, KeyError, IndexError):
+                    continue
+            return None
+
+        items = await asyncio.gather(*(one(p) for p in parts))
+        ok = [i for i in items if i is not None]
+        if len(ok) < len(items):
+            self.stats["encode_failures"] += len(items) - len(ok)
+        self.stats["encoded_items"] += len(ok)
+        if ok:
+            body = dict(body)
+            body["mm_items"] = ok  # engine matches by part hash; missing items
+            # re-encode there (tower) or degrade the request to text-only
+        return body
 
     async def _run_prefill(self, path: str, body: dict, prefiller: str) -> Optional[dict]:
         """Phase 1: remote prefill. Returns the transfer handle, or None → fallback."""
@@ -174,12 +233,18 @@ def main() -> None:
                     help="local decode engine address")
     ap.add_argument("--enable-prefiller-sampling", action="store_true")
     ap.add_argument("--prefill-timeout", type=float, default=120.0)
+    ap.add_argument("--encode-hosts", default="",
+                    help="comma-separated encode-worker host:port pool (E/PD); "
+                         "empty disables the encode stage")
+    ap.add_argument("--encode-timeout", type=float, default=60.0)
     args = ap.parse_args()
 
     sidecar = RoutingSidecar(
         args.engine, host=args.host, port=args.port,
         enable_prefiller_sampling=args.enable_prefiller_sampling,
         prefill_timeout_s=args.prefill_timeout,
+        encode_hosts=[h for h in args.encode_hosts.split(",") if h],
+        encode_timeout_s=args.encode_timeout,
     )
 
     async def run() -> None:

@@ -33,7 +33,7 @@ import struct
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 import numpy as np
 
@@ -387,16 +387,40 @@ class KVTransferClient:
 # ---------------------------------------------------------------------------
 
 
-def export_from_engine(engine, source: KVTransferSource, request_id: str,
-                       token_ids: list[int], lora_id: Optional[str] = None) -> KVTransferParams:
-    """Export a finished prefill request's resident KV blocks (caller holds the
-    engine lock — the step loop must not evict pages mid-gather)."""
+@dataclass
+class StagedExport:
+    """In-flight device→host staging for one request's KV export.
+
+    ``parts`` are device-resident chunk gathers ([L, n_i, ps, 2Hk, Dhp]) with
+    device→host copies already started — the engine lock can be released the
+    moment this object exists; the bytes stream back while the engine keeps
+    stepping (the async analogue of the reference's pinned-staging DMA overlap,
+    kv-offloader.md:33-40)."""
+
+    request_id: str
+    hashes: list[int]
+    chunks: list[list[int]]
+    parts: list[Any]
+
+
+def export_begin(engine, request_id: str, token_ids: list[int],
+                 lora_id: Optional[str] = None,
+                 staging_pages: int = 16,
+                 mm_hashes: Sequence[bytes] = ()) -> tuple[KVTransferParams, Optional[StagedExport]]:
+    """Phase 1 (caller holds the engine lock, cheap): resolve the resident block
+    chain and DISPATCH chunked device gathers with async host copies. The gathers
+    read the cache value as of dispatch, so later steps/evictions can't corrupt
+    the export — the runtime orders the donated step after these reads."""
+    import jax.numpy as jnp
+
     from llmd_tpu.core.kv_events import block_keys_for_tokens
 
     ps = engine.cfg.page_size
-    # generation-scoped key so exported hashes line up with the engine's own
-    # committed blocks (plain name when LoRA serving is off)
-    keys = block_keys_for_tokens(token_ids, ps, engine._lora_hash_key(lora_id))
+    # generation-scoped lora key + media hashes, so exported keys line up with
+    # the engine's own committed blocks (kv_manager.maybe_commit_blocks folds
+    # BOTH into every block hash)
+    keys = block_keys_for_tokens(token_ids, ps, engine._lora_hash_key(lora_id),
+                                 mm_hashes)
     pids: list[int] = []
     hashes: list[int] = []
     chunks: list[list[int]] = []
@@ -407,16 +431,53 @@ def export_from_engine(engine, source: KVTransferSource, request_id: str,
         pids.append(pid)
         hashes.append(h)
         chunks.append(token_ids[i * ps : (i + 1) * ps])
-    if pids:
-        blocks = extract_blocks(engine.cache, pids, engine.cfg.num_pages)
-        source.register(request_id, hashes, chunks, blocks)
-    return KVTransferParams(
-        remote_request_id=request_id, num_blocks=len(pids),
+    params = KVTransferParams(remote_request_id=request_id, num_blocks=len(pids))
+    if not pids:
+        return params, None
+    P = engine.cfg.num_pages
+    L = engine.cache.shape[0] // P
+    lrows = np.arange(L)[:, None]
+    parts: list[Any] = []
+    for i in range(0, len(pids), max(1, staging_pages)):
+        pg = np.asarray(pids[i : i + staging_pages], np.int32)
+        part = engine.cache[jnp.asarray(lrows * P + pg[None, :])]  # [L, n_i, ...]
+        try:
+            part.copy_to_host_async()  # start D2H now; fetch happens off-lock
+        except (AttributeError, RuntimeError):
+            pass
+        parts.append(part)
+    return params, StagedExport(request_id, hashes, chunks, parts)
+
+
+def export_finish(staged: StagedExport, source: KVTransferSource) -> int:
+    """Phase 2 (engine lock NOT held): drain the staged copies into one
+    contiguous block-major buffer and register the export. Returns blocks."""
+    import jax
+
+    blocks = np.concatenate(
+        [np.moveaxis(np.asarray(jax.device_get(p)), 1, 0) for p in staged.parts],
+        axis=0,
     )
+    source.register(staged.request_id, staged.hashes, staged.chunks,
+                    np.ascontiguousarray(blocks))
+    return blocks.shape[0]
+
+
+def export_from_engine(engine, source: KVTransferSource, request_id: str,
+                       token_ids: list[int], lora_id: Optional[str] = None,
+                       mm_hashes: Sequence[bytes] = ()) -> KVTransferParams:
+    """Synchronous convenience wrapper (tests / non-threaded callers): both
+    phases back to back under whatever locking the caller provides."""
+    params, staged = export_begin(engine, request_id, token_ids, lora_id,
+                                  mm_hashes=mm_hashes)
+    if staged is not None:
+        export_finish(staged, source)
+    return params
 
 
 def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
-                       lora_id: Optional[str] = None) -> int:
+                       lora_id: Optional[str] = None,
+                       mm_hashes: Sequence[bytes] = ()) -> int:
     """Commit pulled blocks into the local allocator + cache as prefix-cache entries
     (caller holds the engine lock). Returns blocks injected.
 
@@ -427,7 +488,7 @@ def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
 
     ps = engine.cfg.page_size
     lora_key = engine._lora_hash_key(lora_id)
-    keys = block_keys_for_tokens(token_ids, ps, lora_key)
+    keys = block_keys_for_tokens(token_ids, ps, lora_key, mm_hashes)
     take: list[tuple[int, int]] = []  # (pulled_idx, page_id)
     parent_of: dict[int, Optional[int]] = {}
     parent: Optional[int] = None

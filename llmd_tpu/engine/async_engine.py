@@ -61,6 +61,7 @@ class AsyncLLMEngine:
         sampling: SamplingParams,
         lora_id: Optional[str] = None,
         rank: int = 0,
+        mm_items=None,
     ) -> AsyncIterator[EngineOutput]:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -68,7 +69,7 @@ class AsyncLLMEngine:
         try:
             with self._lock:
                 self.engine.add_request(request_id, token_ids, sampling, lora_id,
-                                        rank=rank)
+                                        rank=rank, mm_items=mm_items)
         except ValueError:
             self._streams.pop(request_id, None)
             raise

@@ -42,6 +42,13 @@ class EngineConfig:
     # on call N's device-resident sampled tokens, read N's results while N+1 runs —
     # hides the device→host round-trip that otherwise serializes every call.
     pipeline_decode: bool = True
+    # In-flight fused-decode calls the host keeps queued (pipeline_decode only).
+    # Depth 1 leaves the device idle for one round trip between calls (N+1's
+    # launch only reaches the device around the time N's tokens reach the host);
+    # depth 2 keeps a launched call behind the running one, so the device goes
+    # back-to-back and the host round-trip fully hides. Costs up to
+    # depth*decode_steps speculative tokens per sequence at EOS.
+    pipeline_depth: int = 2
     # KV offload tier (pages of CPU-side cache; 0 = disabled) — K3 equivalent
     # (TPU_OFFLOAD_NUM_CPU_CHUNKS / STAGING_BLOCKS knobs of the reference connector).
     cpu_offload_pages: int = 0
@@ -52,6 +59,11 @@ class EngineConfig:
     offload_watermark_pages: int = 8
     # FS tier below the CPU tier (llmd_fs_backend shared_storage_path; None = off).
     offload_fs_path: "str | None" = None
+    # Out-of-tree KV connector (K5: LMCache/Mooncake/KVBM seam) — a name from
+    # llmd_tpu.kv.connector_api's registry; the external engine covers prompt
+    # suffixes beyond the local HBM + native CPU/FS tiers.
+    kv_connector: "str | None" = None
+    kv_connector_params: "dict | None" = None
     # P/D role (disaggregation/README.md roles kv_producer/kv_consumer/both)
     role: str = "both"
     # Attention kernel: "auto" = Pallas ragged-paged-attention on TPU / XLA

@@ -80,7 +80,10 @@ class EngineStats:
     time_device_decode: float = 0.0  # the decode-call share of time_device
     time_postprocess: float = 0.0  # host output handling after device sync
     n_unified_steps: int = 0
-    n_decode_calls: int = 0
+    n_decode_calls: int = 0  # fused decode calls PROCESSED (results applied)
+    n_decode_dispatches: int = 0  # fused decode calls LAUNCHED; must equal
+    # n_decode_calls once the engine drains — a gap means an in-flight record
+    # was orphaned (its sampled tokens silently dropped)
 
 
 class LLMEngine:
@@ -135,14 +138,31 @@ class LLMEngine:
                 pages_per_layer=engine_cfg.num_pages,
             )
             self.alloc.evict_hook = lambda h, pid: self.offload.on_evict(self.cache, h, pid)
+        # K5: out-of-tree connector — external engine behind the native tiers
+        self.kv_connector = None
+        self._connector_pool = None
+        if engine_cfg.kv_connector:
+            import concurrent.futures
+
+            from llmd_tpu.kv.connector_api import build_kv_connector
+
+            self.kv_connector = build_kv_connector(
+                engine_cfg.kv_connector, engine_cfg.kv_connector_params)
+            # one drain thread: saves stream out in retirement order without
+            # ever blocking the locked engine step loop
+            self._connector_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="kv-connector")
         self.waitq: list[deque[Sequence]] = [deque() for _ in range(R)]
         self.waiting = self.waitq[0]  # rank-0 alias (single-rank compat)
         self.running: list[Optional[Sequence]] = [None] * engine_cfg.max_batch_size
         self.seqs: dict[str, Sequence] = {}
         self.stats = EngineStats()
+        # engine-emitted predictor training rows (drained by the server's
+        # trace-forwarding loop or read directly by offline training)
+        self.latency_trace: deque[dict] = deque(maxlen=4096)
         self._key = jax.random.PRNGKey(seed)
         self._outputs: list[EngineOutput] = []
-        self._pending_decode: Optional[dict] = None  # in-flight pipelined call
+        self._pending_decode: list[dict] = []  # in-flight pipelined decode calls
 
         if params is None:
             params = init_params(model_cfg, jax.random.PRNGKey(seed))
@@ -207,7 +227,8 @@ class LLMEngine:
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))
 
         def _unified(params, cache, tokens, positions, seq_slots, page_tables,
-                     kv_lens, cu_q_lens, num_seqs, lora_tok):
+                     kv_lens, cu_q_lens, num_seqs, lora_tok,
+                     mm_embeds=None, mm_mask=None):
             """Flat mixed batch (prefill chunks + decode tokens); returns each
             sequence's last-row logits [B, vocab]."""
             # flat token dim shards over dp×sp jointly: data-parallel decode rows
@@ -220,6 +241,7 @@ class LLMEngine:
                 kv_lens, cu_q_lens=cu_q_lens, num_seqs=num_seqs, attn_impl=attn,
                 moe_matmul_impl=moe_impl,
                 lora_indices=lora_tok if use_lora else None, lora_scale=lora_scale,
+                mm_embeds=mm_embeds, mm_mask=mm_mask,
             )
             last_rows = jnp.clip(cu_q_lens[1 : B + 1] - 1, 0, NT - 1)  # [B]
             logits = unembed(cfg, params, hidden[last_rows])  # [B, vocab]
@@ -537,6 +559,7 @@ class LLMEngine:
         sampling: Optional[SamplingParams] = None,
         lora_id: Optional[str] = None,
         rank: int = 0,
+        mm_items: Optional[list[tuple[bytes, np.ndarray]]] = None,
     ) -> None:
         sampling = sampling or SamplingParams()
         if not token_ids:
@@ -555,12 +578,39 @@ class LLMEngine:
             # vLLM returns 404 for unknown adapters; silently serving base
             # weights would also poison the prefix cache under this name
             raise ValueError(f"unknown LoRA adapter {lora_id!r}")
+        mm_items = mm_items or []
+        if mm_items:
+            k = self.model_cfg.mm_tokens
+            if k <= 0:
+                raise ValueError("model has no vision tower (mm_tokens=0)")
+            n_ph = sum(1 for t in token_ids if t == self.model_cfg.mm_placeholder_id)
+            if n_ph != k * len(mm_items):
+                raise ValueError(
+                    f"{len(mm_items)} media items need {k * len(mm_items)} "
+                    f"placeholder tokens, prompt has {n_ph}")
+            for h, emb in mm_items:
+                if emb.shape != (k, self.model_cfg.hidden_size):
+                    raise ValueError(f"mm embedding shape {emb.shape} != "
+                                     f"({k}, {self.model_cfg.hidden_size})")
         seq = Sequence(
             request_id=request_id, token_ids=list(token_ids), prompt_len=len(token_ids),
             max_tokens=sampling.max_tokens, sampling=sampling, lora_id=lora_id,
             lora_key=self._lora_hash_key(lora_id), arrival_time=time.monotonic(),
-            rank=rank,
+            rank=rank, mm_items=mm_items,
         )
+        # pod state as a router would have observed it at arrival — joined with
+        # the observed latencies at retirement into one predictor training row
+        inflight = sum(
+            len(s.token_ids) for s in self.running if s is not None
+        ) + sum(s.prompt_len for q in self.waitq for s in q)
+        seq.admit_features = {
+            "kv_usage": sum(a.num_active for a in self.allocs) / max(1, self.cfg.num_pages),
+            "input_len": float(len(token_ids)),
+            "queue_depth": float(sum(len(q) for q in self.waitq)),
+            "running_requests": float(sum(1 for s in self.running if s is not None)),
+            "inflight_tokens": float(inflight),
+            "prefix_match_pct": 0.0,  # known at admission; patched there
+        }
         self.seqs[request_id] = seq
         self.waitq[rank].append(seq)
         if self.lora_registry is not None:
@@ -584,9 +634,21 @@ class LLMEngine:
             pass
         self._free_seq(seq)
 
+    def drain_latency_trace(self) -> list[dict]:
+        """Return + clear the accumulated predictor training rows.
+
+        popleft-until-empty: atomic per element, so concurrent appends from the
+        engine thread are neither dropped nor do they break iteration."""
+        rows: list[dict] = []
+        while True:
+            try:
+                rows.append(self.latency_trace.popleft())
+            except IndexError:
+                return rows
+
     def has_work(self) -> bool:
         return (any(self.waitq) or any(s is not None for s in self.running)
-                or self._pending_decode is not None)
+                or bool(self._pending_decode))
 
     # ------------------------------------------------------- scheduling core
     def _free_seq(self, seq: Sequence) -> None:
@@ -617,7 +679,8 @@ class LLMEngine:
             # prefix-cache lookup over complete prompt blocks
             from llmd_tpu.core.kv_events import block_keys_for_tokens
 
-            keys = block_keys_for_tokens(seq.token_ids[: seq.prompt_len], ps, seq.lora_key)
+            keys = block_keys_for_tokens(seq.token_ids[: seq.prompt_len], ps,
+                                         seq.lora_key, seq.mm_hashes())
             hit_pages = alloc.match_prefix(keys) if self.cfg.enable_prefix_caching else []
             # never reuse the whole prompt — the final token's logits must be computed
             max_reuse = max(0, (seq.prompt_len - 1) // ps)
@@ -626,6 +689,11 @@ class LLMEngine:
             n_offload = 0
             if self.offload is not None and len(hit_pages) < max_reuse:
                 n_offload = self.offload.match_suffix(keys[len(hit_pages) : max_reuse])
+            # ...and past the native tiers, the out-of-tree connector's engine
+            n_conn = 0
+            if self.kv_connector is not None and len(hit_pages) + n_offload < max_reuse:
+                n_conn = self.kv_connector.get_num_matched_blocks(
+                    keys[len(hit_pages) + n_offload : max_reuse])
 
             need_new = (min(seq.prompt_len + 1, self.cfg.max_pages_per_seq * ps) + ps - 1) // ps - len(hit_pages)
             # acquire_cached pulls hit pages out of the evictable LRU, so they stop
@@ -653,10 +721,17 @@ class LLMEngine:
                 alloc.acquire_cached(pid)
             n_hbm = len(hit_pages)
             off_pages = self._reload_offloaded(seq, keys, n_hbm, n_offload)
-            seq.pages = list(hit_pages) + off_pages
-            seq.block_hashes = keys[: n_hbm + len(off_pages)]
-            seq.num_computed = (n_hbm + len(off_pages)) * ps
+            conn_pages: list[int] = []
+            if n_conn > 0 and len(off_pages) == n_offload:
+                conn_pages = self._load_from_connector(
+                    seq, keys, n_hbm + len(off_pages), n_conn)
+            seq.pages = list(hit_pages) + off_pages + conn_pages
+            seq.block_hashes = keys[: n_hbm + len(off_pages) + len(conn_pages)]
+            seq.num_computed = (n_hbm + len(off_pages) + len(conn_pages)) * ps
             seq.num_cached_prompt = seq.num_computed
+            if seq.admit_features is not None:
+                seq.admit_features["prefix_match_pct"] = (
+                    seq.num_cached_prompt / max(1, seq.prompt_len))
             seq.slot = slot
             self.running[slot] = seq
             waiting.popleft()
@@ -691,6 +766,31 @@ class LLMEngine:
             self.alloc.commit_block(pid, keys[bi], chunk, parent, seq.lora_key)
         self.stats.total_offload_loads += len(off_pids)
         return off_pids
+
+    def _load_from_connector(self, seq: Sequence, keys: list[int], start: int,
+                             n_conn: int) -> list[int]:
+        """Pull blocks from the out-of-tree connector's engine into fresh HBM
+        pages and commit them as prefix-cache entries (K5 load path)."""
+        ps = self.cfg.page_size
+        pids: list[int] = []
+        for _ in range(n_conn):
+            pid = self.alloc.allocate()
+            if pid is None:
+                break
+            pids.append(pid)
+        if not pids:
+            return []
+        self.cache, n_loaded = self.kv_connector.load_blocks(
+            self.cache, keys[start : start + len(pids)], pids, self.cfg.num_pages)
+        for pid in pids[n_loaded:]:  # external engine lost the tail meanwhile
+            self.alloc.release(pid)
+        pids = pids[:n_loaded]
+        for i, pid in enumerate(pids):
+            bi = start + i
+            chunk = seq.token_ids[bi * ps : (bi + 1) * ps]
+            parent = keys[bi - 1] if bi > 0 else None
+            self.alloc.commit_block(pid, keys[bi], chunk, parent, seq.lora_key)
+        return pids
 
     def _ensure_pages(self, seq: Sequence, upto_tokens: int) -> bool:
         ps = self.cfg.page_size
@@ -835,6 +935,15 @@ class LLMEngine:
         pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
         lens = np.ones((B,), np.int32)
         cu = np.zeros((B + 1,), np.int32)
+        # only pay the mm staging buffers when this step actually carries media
+        # prefill rows (text-only steps on a VL model jit a no-mm variant)
+        is_vl = self.model_cfg.mm_tokens > 0 and any(
+            s.mm_items and not is_decode for s, _, is_decode in plan)
+        if is_vl:
+            # row-aligned with the flat token batch: mm_embeds[i] replaces the
+            # embedding of tokens[i] where mm_mask[i] (encode-stage injection)
+            mm_embeds = np.zeros((NT, self.model_cfg.hidden_size), np.float32)
+            mm_mask = np.zeros((NT,), np.bool_)
         off = 0
         for i, (s, n, is_decode) in enumerate(plan):
             start = len(s.token_ids) - 1 if is_decode else s.num_computed
@@ -844,15 +953,27 @@ class LLMEngine:
             lora_tok[off : off + n] = self._lora_slot(s)
             pts[i, : len(s.pages)] = s.pages
             lens[i] = start + n
+            if is_vl and s.mm_items and not is_decode:
+                ph = self.model_cfg.mm_placeholder_id
+                k = self.model_cfg.mm_tokens
+                occ = sum(1 for t in s.token_ids[:start] if t == ph)
+                for j in range(n):
+                    if s.token_ids[start + j] == ph:
+                        item, row = occ // k, occ % k
+                        if item < len(s.mm_items):
+                            mm_embeds[off + j] = s.mm_items[item][1][row]
+                            mm_mask[off + j] = True
+                        occ += 1
             off += n
             cu[i + 1] = off
         cu[len(plan) + 1 :] = off
 
         t1 = time.perf_counter()
+        mm_args = ((jnp.asarray(mm_embeds), jnp.asarray(mm_mask)) if is_vl else ())
         logits, self.cache, cnt = self._unified_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(cu),
-            jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok),
+            jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok), *mm_args,
         )
         if self.cfg.instrument:
             logits.block_until_ready()
@@ -903,8 +1024,8 @@ class LLMEngine:
             return
         B = self.cfg.max_batch_size
         k = max(1, self.cfg.decode_steps)
-        pend = self._pending_decode
-        off = pend["k"] if pend is not None else 0
+        q = self._pending_decode
+        off = sum(rec["k"] for rec in q)
 
         # A k-step scan writes KV for positions len-1 .. len+off+k-2 → needs
         # len+off+k-1 slots. If the pool can't cover the horizon, flush and
@@ -923,35 +1044,41 @@ class LLMEngine:
         if not active:
             return
 
-        if pend is not None:
+        if q:
             same = {(s.request_id, s.slot) for s in active} == {
-                (s.request_id, slot) for s, slot in pend["rows"]}
+                (s.request_id, slot) for s, slot in q[-1]["rows"]}
             if same and self.cfg.pipeline_decode:
-                rec = self._decode_dispatch(active, k, chain=pend, wall_start=t0)
-                self._decode_process(pend)
-                self._pending_decode = rec
+                rec = self._decode_dispatch(active, k, chain=q[-1], wall_start=t0,
+                                            off=off)
+                q.append(rec)
+                # keep up to pipeline_depth calls in flight: the queued call
+                # behind the running one lets the device go back-to-back while
+                # the finished call's tokens cross back to the host
+                if len(q) > max(1, self.cfg.pipeline_depth):
+                    self._decode_process(q.pop(0))
                 return
             self._flush_pending_decode()
+            q = self._pending_decode  # flush rebinds the queue — drop the stale ref
             active = [s for s in self._decode_ready() if s.slot >= 0]
             if not active:
                 return
         rec = self._decode_dispatch(active, k, chain=None, wall_start=t0)
         if self.cfg.pipeline_decode:
-            self._pending_decode = rec
+            q.append(rec)
         else:
             self._decode_process(rec)
 
     def _flush_pending_decode(self) -> None:
-        pend, self._pending_decode = self._pending_decode, None
-        if pend is not None:
-            self._decode_process(pend)
+        q, self._pending_decode = self._pending_decode, []
+        for rec in q:
+            self._decode_process(rec)
 
     def _decode_dispatch(self, active: list[Sequence], k: int, chain: Optional[dict],
-                         wall_start: float) -> dict:
-        """Pack host state (+ a pending call's un-processed offset) and launch one
-        fused k-step decode. Returns the in-flight record; results are NOT read."""
+                         wall_start: float, off: int = 0) -> dict:
+        """Pack host state (+ the un-processed offset across ALL in-flight calls)
+        and launch one fused k-step decode chained on ``chain``'s device-resident
+        last tokens. Returns the in-flight record; results are NOT read."""
         B = self.cfg.max_batch_size
-        off = chain["k"] if chain is not None else 0
         pos = np.full((B,), -1, np.int32)
         pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
         lens = np.ones((B,), np.int32)
@@ -984,6 +1111,17 @@ class LLMEngine:
             jnp.asarray(tp), sub, jnp.asarray(steps_left), jnp.asarray(lora_idx),
         )
         self.stats.time_decode_steps += time.perf_counter() - wall_start
+        self.stats.n_decode_dispatches += 1
+        # Start the device->host copy of everything _decode_process will read.
+        # Remote/tunneled runtimes defer execution until a result is demanded;
+        # the async-copy hint makes the call run (and its tokens land on the
+        # host) while the host loop does other work, so the later np.asarray
+        # is a near-free read instead of RTT + compute.
+        for arr in (toks_out,) if self._eplb is None else (toks_out, cnt):
+            try:
+                arr.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                break
         return {
             "rows": [(s, s.slot) for s in active],
             "toks_out": toks_out, "last_toks": last_toks, "cnt": cnt, "k": k,
@@ -1034,6 +1172,57 @@ class LLMEngine:
         """Shared retirement path: free slot + pages, drop from the live map."""
         seq.finished = True
         seq.finish_reason = reason
+        if self.kv_connector is not None and seq.block_hashes:
+            # K5 save path: DISPATCH the device gather here (cheap — reads the
+            # cache value as of now, ordering guaranteed vs later donated
+            # steps), then drain + hand bytes to the external engine on the
+            # connector thread, off the locked step loop (same staging shape as
+            # export_begin/export_finish).
+            try:
+                import jax as _jax
+                import jax.numpy as _jnp
+
+                n = len(seq.block_hashes)
+                ps = self.cfg.page_size
+                P = self.cfg.num_pages
+                L = self.cache.shape[0] // P
+                rows = np.arange(L)[:, None] * P + np.asarray(seq.pages[:n], np.int32)[None, :]
+                part = self.cache[_jnp.asarray(rows)]  # [L, n, ps, 2Hk, Dhp]
+                try:
+                    part.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+                hashes = list(seq.block_hashes)
+                chunks = [seq.token_ids[i * ps : (i + 1) * ps] for i in range(n)]
+                rid = seq.request_id
+
+                def _drain(part=part, hashes=hashes, chunks=chunks, rid=rid):
+                    try:
+                        blocks = np.ascontiguousarray(
+                            np.moveaxis(np.asarray(_jax.device_get(part)), 1, 0))
+                        self.kv_connector.save_blocks(hashes, chunks, blocks)
+                    except Exception:
+                        pass  # external engine down: never fails serving
+                    try:
+                        self.kv_connector.request_finished(rid)
+                    except Exception:
+                        pass
+
+                self._connector_pool.submit(_drain)
+            except Exception:
+                pass  # dispatch failure must not fail retirement either
+        if seq.admit_features is not None and seq.first_token_time is not None:
+            # one predictor training row per completed request (engine-emitted
+            # traces, not a synthetic generator — latency-predictor.md:58)
+            now = time.monotonic()
+            n_gen = max(1, seq.num_generated)
+            self.latency_trace.append(dict(
+                seq.admit_features,
+                tokens_generated=float(n_gen),
+                ttft_ms=(seq.first_token_time - seq.arrival_time) * 1e3,
+                tpot_ms=((now - seq.first_token_time) / max(1, n_gen - 1)) * 1e3
+                if n_gen > 1 else None,
+            ))
         if seq.slot >= 0:
             self.running[seq.slot] = None
             seq.slot = -1

@@ -236,6 +236,16 @@ class Sequence:
     arrival_time: float = 0.0
     first_token_time: Optional[float] = None
     rank: int = 0  # owning DP rank scheduler (wide-EP; 0 in single-rank engines)
+    # pod-state features frozen at arrival/admission — the predictor's training
+    # rows (latency-predictor.md:58): what the EPP could have observed when it
+    # routed this request, joined with the latencies the engine then delivered
+    admit_features: Optional[dict] = None
+    # multimodal: (content_hash, embeds [mm_tokens, hidden]) per media item, in
+    # prompt order; placeholder occurrence j in token_ids draws row j % k of
+    # item j // k. Hashes fold into every block key (kv-indexer.md mm extra
+    # keys) so two prompts with identical tokens but different media never share
+    # cache entries.
+    mm_items: list = field(default_factory=list)
 
     @property
     def num_generated(self) -> int:
@@ -248,11 +258,15 @@ class Sequence:
         """Hash+commit any newly completed pages (called after compute advances)."""
         ps = alloc.page_size
         committed = len(self.block_hashes)
+        mm = self.mm_hashes()
         while (committed + 1) * ps <= self.num_computed:
             start = committed * ps
             chunk = self.token_ids[start : start + ps]
             key = self.lora_key if self.lora_key is not None else self.lora_id
-            h = hash_block_tokens(self.last_block_hash(), chunk, key)
+            h = hash_block_tokens(self.last_block_hash(), chunk, key, mm)
             alloc.commit_block(self.pages[committed], h, chunk, self.last_block_hash(), key)
             self.block_hashes.append(h)
             committed += 1
+
+    def mm_hashes(self) -> list[bytes]:
+        return [h for h, _ in self.mm_items]

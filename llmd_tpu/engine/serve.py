@@ -44,6 +44,9 @@ def main() -> None:
     ap.add_argument("--max-loras", type=int, default=8)
     ap.add_argument("--max-lora-rank", type=int, default=8)
     ap.add_argument("--cpu", action="store_true", help="force CPU platform (dev)")
+    ap.add_argument("--predictor-train-url", default=None,
+                    help="latency-predictor training server base URL; completed "
+                         "requests' TTFT/TPOT rows stream to its POST /samples")
     ap.add_argument("--data-parallel-size", type=int, default=1, dest="dp",
                     help="wide-EP DP rank engines sharing one SPMD program; each "
                          "rank serves on port+rank (reference --data-parallel-size)")
@@ -118,6 +121,7 @@ def main() -> None:
         host=args.host, port=args.port, kv_events_port=args.kv_events_port,
         kv_transfer_port=args.kv_transfer_port,
         tokenizer=tokenizer, params=params,
+        predictor_train_url=args.predictor_train_url,
     )
     if args.advertise_host:
         server.advertise_host = args.advertise_host

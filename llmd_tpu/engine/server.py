@@ -29,7 +29,8 @@ from llmd_tpu.core.kv_events import KVEvent, encode_event_batch, kv_topic
 from llmd_tpu.core.request import SamplingParams, flatten_messages
 from llmd_tpu.disagg.transfer import (
     KVTransferParams,
-    export_from_engine,
+    export_begin,
+    export_finish,
     inject_into_engine,
 )
 from llmd_tpu.engine.async_engine import AsyncLLMEngine
@@ -37,6 +38,12 @@ from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.engine import LLMEngine
 from llmd_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from llmd_tpu.models.config import ModelConfig
+
+
+def _body_has_media(body: dict) -> bool:
+    from llmd_tpu.disagg.encode import iter_media_parts
+
+    return bool(body.get("mm_items")) or next(iter_media_parts(body), None) is not None
 
 
 def _sampling_from_body(body: dict) -> SamplingParams:
@@ -69,6 +76,7 @@ class EngineServer:
         engine: Optional[LLMEngine] = None,
         async_engine: Optional["AsyncLLMEngine"] = None,
         rank: int = 0,
+        predictor_train_url: Optional[str] = None,
     ) -> None:
         self.model_name = model_name
         self.host, self.port = host, port
@@ -82,6 +90,9 @@ class EngineServer:
         self._zctx = None
         self._pub = None
         self._kv_seq = 0
+        # training-sidecar feed: completed requests' latency rows stream to the
+        # predictor's POST /samples (the reference's vllm→trainer scrape flow)
+        self.predictor_train_url = predictor_train_url
         self._pending_events: list[KVEvent] = []
         self._ev_lock = __import__("threading").Lock()
 
@@ -104,6 +115,14 @@ class EngineServer:
             self.async_engine = AsyncLLMEngine(self.engine)
         self._runner: Optional[web.AppRunner] = None
         self.request_count = 0
+        self._vision = None  # lazy in-process vision tower (combined-PD mode)
+        self._vision_lock = __import__("threading").Lock()  # one compile, ever
+        # Conversations API store (pod-local; router keeps traffic sticky by
+        # id). LRU-capped: abandoned conversations must not grow without bound.
+        from collections import OrderedDict
+
+        self._conversations: "OrderedDict[str, dict]" = OrderedDict()
+        self._max_conversations = 4096
         from llmd_tpu.obs.tracing import global_tracer
 
         self.tracer = global_tracer()  # engine hop joins the EPP trace
@@ -133,6 +152,23 @@ class EngineServer:
                 except Exception:
                     pass  # PUB with no subscribers / full HWM: drop (fire-and-forget)
 
+    async def _trace_flush_loop(self) -> None:
+        """Forward engine-emitted latency rows to the predictor trainer."""
+        import aiohttp
+
+        while True:
+            await asyncio.sleep(1.0)
+            rows = self.engine.drain_latency_trace()
+            if not rows:
+                continue
+            try:
+                async with aiohttp.ClientSession() as sess:
+                    await sess.post(f"{self.predictor_train_url}/samples",
+                                    json={"samples": rows},
+                                    timeout=aiohttp.ClientTimeout(total=2.0))
+            except Exception:
+                pass  # trainer down: rows already drained, next batch retries fresh
+
     # -- lifecycle ---------------------------------------------------------
     @property
     def address(self) -> str:
@@ -158,6 +194,14 @@ class EngineServer:
         app.router.add_post("/v1/load_lora_adapter", self._load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self._unload_lora)
         app.router.add_post("/v1/embeddings", self._embeddings)
+        # OpenAI Responses + Conversations APIs (epp-http-apis.md:11,153-183;
+        # request-handling.md:73 lists both under the openai parser)
+        app.router.add_post("/v1/responses", self._responses)
+        app.router.add_post("/v1/conversations", self._conv_create)
+        app.router.add_get("/v1/conversations/{cid}", self._conv_get)
+        app.router.add_delete("/v1/conversations/{cid}", self._conv_delete)
+        app.router.add_post("/v1/conversations/{cid}/items", self._conv_add_items)
+        app.router.add_get("/v1/conversations/{cid}/items", self._conv_list_items)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -174,6 +218,8 @@ class EngineServer:
             else:
                 self._pub.bind(f"tcp://0.0.0.0:{self.kv_events_port}")
             asyncio.get_running_loop().create_task(self._kv_flush_loop())
+        if self.predictor_train_url is not None:
+            asyncio.get_running_loop().create_task(self._trace_flush_loop())
 
     async def stop(self) -> None:
         self.async_engine.stop()
@@ -187,7 +233,7 @@ class EngineServer:
 
     # -- helpers -----------------------------------------------------------
     def _pull_remote_kv(self, ktp: "KVTransferParams", token_ids: list[int],
-                        lora_id=None) -> int:
+                        lora_id=None, mm_hashes: list = ()) -> int:
         """Pull + inject remote prefill KV; any failure → recompute locally
         (kv_load_failure_policy=recompute, operations-vllm.md:84-100)."""
         try:
@@ -198,7 +244,8 @@ class EngineServer:
                 self.transfer_stats["pull_failures"] += 1
                 return 0
             n = self.async_engine.run_locked(
-                lambda: inject_into_engine(self.engine, pulled, token_ids, lora_id)
+                lambda: inject_into_engine(self.engine, pulled, token_ids, lora_id,
+                                           mm_hashes)
             )
             self.transfer_stats["injected_blocks"] += n
             # free producer-side blocks (NIXL-notify semantics)
@@ -217,6 +264,89 @@ class EngineServer:
             text = str(body.get("prompt", ""))
         return self.tokenizer.encode(text)
 
+    def _mm_token_stream(self, body: dict) -> tuple[list[int], list[dict]]:
+        """VL token stream: media parts expand to cfg.mm_tokens placeholder ids.
+
+        Shared by /render and the generate path — the router's precise
+        token-producer tokenizes via /render, so the engine MUST hash blocks
+        over this exact stream or prefix-cache routing silently scores 0 for
+        every multimodal request. Returns (tokens, media parts in order)."""
+        from llmd_tpu.disagg.encode import is_media_part
+
+        cfg = self.engine.model_cfg
+        pieces: list = []  # str segments; None marks a media slot
+        parts: list[dict] = []
+        for m in body.get("messages", []) or []:
+            content = m.get("content", "")
+            pieces.append(f"{m.get('role', '')}: ")
+            if isinstance(content, list):
+                for part in content:
+                    if is_media_part(part):
+                        pieces.append(None)
+                        parts.append(part)
+                    elif isinstance(part, dict):
+                        pieces.append(part.get("text", "") + " ")
+                    else:
+                        pieces.append(str(part) + " ")
+            else:
+                pieces.append(str(content))
+            pieces.append("\n")
+        token_ids: list[int] = []
+        for p in pieces:
+            if p is None:
+                token_ids.extend([cfg.mm_placeholder_id] * cfg.mm_tokens)
+            elif p:
+                token_ids.extend(self.tokenizer.encode(p))
+        return token_ids, parts
+
+    def _tokenize_mm(self, body: dict) -> tuple[list[int], Optional[list]]:
+        """VL tokenization + embedding resolution: E-stage wire items match by
+        canonical part hash; missing items encode in-process when this server
+        has a vision tower, otherwise the request degrades to the text-only
+        flatten rendering (encode pool down ≠ failed request).
+
+        Returns (tokens, mm_items) — mm_items None means degraded text-only."""
+        from llmd_tpu.disagg.encode import (
+            VisionRunner,
+            media_bytes_from_part,
+            mm_item_from_wire,
+            part_identity,
+        )
+
+        cfg = self.engine.model_cfg
+        token_ids, parts = self._mm_token_stream(body)
+        wire_by_hash: dict[bytes, tuple[bytes, "object"]] = {}
+        for d in body.get("mm_items") or []:
+            try:
+                h, emb = mm_item_from_wire(d, cfg.hidden_size)
+                wire_by_hash[h] = (h, emb)
+            except Exception:
+                continue  # malformed wire item: treat as missing
+        mm_items = []
+        missing: list[tuple[int, dict]] = []
+        for i, part in enumerate(parts):
+            h = part_identity(part)
+            got = wire_by_hash.get(h)
+            if got is not None:
+                mm_items.append(got)
+            else:
+                mm_items.append(None)
+                missing.append((i, part))
+        if missing:
+            if not cfg.has_vision:
+                # true E/PD worker without a tower: degrade to text-only
+                # (media identity still lands in the stream via flatten's
+                # <kind:hash> rendering) rather than 500ing the request
+                return self._tokenize_body(body), None
+            with self._vision_lock:
+                if self._vision is None:
+                    self._vision = VisionRunner(cfg)
+            payloads = [media_bytes_from_part(part) or b"" for _, part in missing]
+            encoded = self._vision.encode(payloads)
+            for (i, part), (_h, emb) in zip(missing, encoded):
+                mm_items[i] = (part_identity(part), emb)
+        return token_ids, mm_items
+
     # -- handlers ----------------------------------------------------------
     async def _completions(self, request: web.Request):
         return await self._generate(request, chat=False)
@@ -230,7 +360,19 @@ class EngineServer:
         except Exception:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
         self.request_count += 1
-        token_ids = self._tokenize_body(body)
+        mm_items = None
+        if self.engine.model_cfg.mm_tokens > 0 and _body_has_media(body):
+            try:
+                # executor thread: in-process vision encode (jit compile +
+                # device compute in combined-PD mode) must not stall the loop
+                token_ids, mm_items = await asyncio.get_running_loop().run_in_executor(
+                    None, self._tokenize_mm, body)
+            except Exception as e:
+                return web.json_response(
+                    {"error": {"message": f"multimodal content: {e}"}}, status=400)
+        else:
+            token_ids = self._tokenize_body(body)
+        mm_hashes = [h for h, _ in mm_items] if mm_items else []
         sampling = _sampling_from_body(body)
         if not sampling.ignore_eos:
             sampling.stop_token_ids = tuple(sampling.stop_token_ids) + (self.tokenizer.eos_id,)
@@ -262,12 +404,12 @@ class EngineServer:
         if ktp.do_remote_prefill and self.transfer_client is not None:
             span.add_event("kv_transfer.pull")
             await asyncio.get_running_loop().run_in_executor(
-                None, self._pull_remote_kv, ktp, token_ids, lora_id
+                None, self._pull_remote_kv, ktp, token_ids, lora_id, mm_hashes
             )
 
         try:
             gen = self.async_engine.generate(rid, token_ids, sampling, lora_id,
-                                             rank=self.rank)
+                                             rank=self.rank, mm_items=mm_items)
             if not stream:
                 out_ids: list[int] = []
                 cached = 0
@@ -292,16 +434,25 @@ class EngineServer:
                     "created": created, "model": model, "usage": usage, "choices": [choice],
                 }
                 if ktp.do_remote_decode and self.transfer_source is not None:
-                    # executor thread: the engine lock + D2H gather must not stall
-                    # the event loop (streams/probes keep flowing during export)
-                    out_params = await asyncio.get_running_loop().run_in_executor(
-                        None,
-                        lambda: self.async_engine.run_locked(
-                            lambda: export_from_engine(
-                                self.engine, self.transfer_source, rid, token_ids, lora_id
+                    # two-phase staging: the engine lock is held only long enough
+                    # to dispatch the chunked gathers (+ async D2H copies); the
+                    # byte drain + registration runs in an executor thread while
+                    # the engine keeps stepping other requests
+                    def _begin():
+                        return self.async_engine.run_locked(
+                            lambda: export_begin(
+                                self.engine, rid, token_ids, lora_id,
+                                staging_pages=self.engine.cfg.offload_staging_blocks,
+                                mm_hashes=mm_hashes,
                             )
-                        ),
-                    )
+                        )
+
+                    loop = asyncio.get_running_loop()
+                    out_params, staged = await loop.run_in_executor(None, _begin)
+                    if staged is not None:
+                        await loop.run_in_executor(
+                            None, lambda: export_finish(staged, self.transfer_source)
+                        )
                     # advertise a routable host, never the bind-any address — the
                     # sidecar falls back to the prefiller's header host when unset
                     routable = self.advertise_host or self.host
@@ -396,11 +547,163 @@ class EngineServer:
             "usage": {"prompt_tokens": total_tokens, "total_tokens": total_tokens},
         })
 
+    # -- Responses / Conversations APIs ------------------------------------
+    # The conversation store is engine-local (a pod-resident dict, like vLLM's);
+    # the router keeps conversation traffic sticky by id so follow-ups land on
+    # the pod holding the state AND its KV prefix cache.
+
+    @staticmethod
+    def _responses_input_to_messages(inp) -> list[dict]:
+        if isinstance(inp, str):
+            return [{"role": "user", "content": inp}]
+        out = []
+        for item in inp or []:
+            if isinstance(item, dict):
+                out.append({"role": item.get("role", "user"),
+                            "content": item.get("content", "")})
+        return out
+
+    async def _responses(self, request: web.Request):
+        """OpenAI Responses API (epp-http-apis.md:153-183): ``input`` + optional
+        ``conversation`` id; conversation context prepends, and the exchange is
+        appended back to the store."""
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        conv_id = body.get("conversation")
+        conv = self._conversations.get(conv_id) if conv_id else None
+        if conv_id and conv is None:
+            return web.json_response(
+                {"error": {"message": f"unknown conversation {conv_id!r}"}}, status=404)
+        new_msgs = self._responses_input_to_messages(body.get("input", ""))
+        messages = (list(conv["items"]) if conv else []) + new_msgs
+        max_out = int(body.get("max_output_tokens", body.get("max_tokens", 16)))
+        chat_body = {
+            "model": body.get("model", self.model_name),
+            "messages": messages,
+            "max_tokens": max_out,
+            "temperature": body.get("temperature", 1.0),
+        }
+        if body.get("ignore_eos"):
+            chat_body["ignore_eos"] = True
+        # same tokenization path as chat (VL content parts included)
+        mm_items = None
+        if self.engine.model_cfg.mm_tokens > 0 and _body_has_media(chat_body):
+            try:
+                token_ids, mm_items = await asyncio.get_running_loop().run_in_executor(
+                    None, self._tokenize_mm, chat_body)
+            except Exception as e:
+                return web.json_response(
+                    {"error": {"message": f"multimodal content: {e}"}}, status=400)
+        else:
+            token_ids = self._tokenize_body(chat_body)
+        sampling = _sampling_from_body(chat_body)
+        if not sampling.ignore_eos:
+            sampling.stop_token_ids = tuple(sampling.stop_token_ids) + (self.tokenizer.eos_id,)
+        rid = f"resp-{uuid.uuid4().hex[:16]}"
+        out_ids: list[int] = []
+        finish = None
+        try:
+            async for out in self.async_engine.generate(rid, token_ids, sampling,
+                                                        rank=self.rank,
+                                                        mm_items=mm_items):
+                out_ids.extend(out.new_token_ids)
+                finish = out.finish_reason
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
+        text = self.tokenizer.decode(out_ids)
+        usage = {"prompt_tokens": len(token_ids), "completion_tokens": len(out_ids),
+                 "total_tokens": len(token_ids) + len(out_ids)}
+        inner = {"model": chat_body["model"]}
+        status = "completed" if finish in (None, "stop", "eos") else "incomplete"
+        resp = {
+            "id": f"resp_{uuid.uuid4().hex[:12]}",
+            "object": "response",
+            "created_at": int(time.time()),
+            "model": inner["model"],
+            "status": status,
+            "output": [{
+                "id": f"msg_{uuid.uuid4().hex[:12]}",
+                "type": "message", "role": "assistant", "status": "completed",
+                "content": [{"type": "output_text", "text": text, "annotations": []}],
+            }],
+            "max_output_tokens": max_out,
+            "usage": {"input_tokens": usage["prompt_tokens"],
+                      "output_tokens": usage["completion_tokens"],
+                      "total_tokens": usage["total_tokens"]},
+        }
+        if status == "incomplete":
+            resp["incomplete_details"] = {"reason": "max_output_tokens"}
+        if conv is not None:
+            conv["items"].extend(new_msgs)
+            conv["items"].append({"role": "assistant", "content": text})
+        if conv_id:
+            resp["conversation"] = conv_id
+        return web.json_response(resp)
+
+    async def _conv_create(self, request: web.Request):
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        # routers inject a pre-generated id so hash-of-id sticky routing is
+        # deterministic across EPP replicas; direct clients get a fresh one
+        cid = str(body.get("id") or f"conv_{uuid.uuid4().hex[:12]}")
+        conv = {"id": cid, "object": "conversation", "created_at": int(time.time()),
+                "items": list(body.get("items", []) or []),
+                "metadata": body.get("metadata") or {}}
+        self._conversations[cid] = conv
+        while len(self._conversations) > self._max_conversations:
+            self._conversations.popitem(last=False)
+        return web.json_response({k: v for k, v in conv.items() if k != "items"})
+
+    def _conv_or_404(self, request):
+        conv = self._conversations.get(request.match_info["cid"])
+        if conv is not None:
+            self._conversations.move_to_end(request.match_info["cid"])
+        return conv
+
+    async def _conv_get(self, request: web.Request):
+        conv = self._conv_or_404(request)
+        if conv is None:
+            return web.json_response({"error": {"message": "not found"}}, status=404)
+        return web.json_response({k: v for k, v in conv.items() if k != "items"})
+
+    async def _conv_delete(self, request: web.Request):
+        conv = self._conversations.pop(request.match_info["cid"], None)
+        if conv is None:
+            return web.json_response({"error": {"message": "not found"}}, status=404)
+        return web.json_response({"id": conv["id"], "object": "conversation.deleted",
+                                  "deleted": True})
+
+    async def _conv_add_items(self, request: web.Request):
+        conv = self._conv_or_404(request)
+        if conv is None:
+            return web.json_response({"error": {"message": "not found"}}, status=404)
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        items = body.get("items", [])
+        conv["items"].extend(items)
+        return web.json_response({"object": "list", "data": items})
+
+    async def _conv_list_items(self, request: web.Request):
+        conv = self._conv_or_404(request)
+        if conv is None:
+            return web.json_response({"error": {"message": "not found"}}, status=404)
+        return web.json_response({"object": "list", "data": conv["items"]})
+
     async def _render(self, request: web.Request):
         try:
             body = await request.json()
         except Exception:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        if self.engine.model_cfg.mm_tokens > 0 and _body_has_media(body):
+            # router-visible rendering must match generate-path hashing exactly
+            token_ids, _ = self._mm_token_stream(body)
+            return web.json_response({"prompt_token_ids": token_ids})
         return web.json_response({"prompt_token_ids": self._tokenize_body(body)})
 
     async def _metrics(self, request: web.Request):

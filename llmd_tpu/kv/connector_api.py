@@ -1,0 +1,113 @@
+"""Out-of-tree KV-cache connector API (K5): third-party cache engines plug in.
+
+The reference integrates LMCache / Mooncake / NVIDIA KVBM through the model
+server's KV-cache connector API — the external engine owns indexing, memory
+management, tiering and storage; the server only asks "how much of this prompt
+do you hold?" and moves bytes (kv-offloader.md:8,70-100). This module is that
+seam for the TPU engine, shaped for XLA's functional cache:
+
+- scheduler-side: ``get_num_matched_blocks`` consults the external engine at
+  admission, AFTER local HBM prefix hits and the native CPU/FS tiers — the
+  connector covers the remaining suffix only;
+- worker-side: ``load_blocks`` returns a NEW cache value (functional update —
+  the engine's cache is an XLA array, not mutable memory) and ``save_blocks``
+  receives block-major host bytes it may hand to any store;
+- lifecycle: ``request_finished`` releases per-request resources.
+
+Connectors register by name (``register_kv_connector``) and activate via
+``EngineConfig.kv_connector`` — the out-of-tree package just imports and
+registers before engine construction, no in-tree changes (the vLLM
+``--kv-transfer-config`` pattern, TPU-side).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class KVConnectorBase:
+    """Interface an external KV-cache engine implements."""
+
+    def __init__(self, params: Optional[dict] = None) -> None:
+        self.params = params or {}
+
+    # ---------------------------------------------------------- scheduler side
+    def get_num_matched_blocks(self, block_hashes: list[int]) -> int:
+        """How many CONSECUTIVE blocks (from the start of the given suffix
+        chain) the external engine can supply. Called under the engine lock at
+        admission; must be cheap (index lookup, no IO)."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- worker side
+    def load_blocks(self, cache, block_hashes: list[int], page_ids: list[int],
+                    pages_per_layer: int):
+        """Write the engine-layout block data for ``block_hashes`` into the
+        given fresh pages. Returns (new_cache, n_loaded); n_loaded < requested
+        means the tail was unavailable after all (engine recomputes it)."""
+        raise NotImplementedError
+
+    def save_blocks(self, block_hashes: list[int], token_chunks: list[list[int]],
+                    blocks: "np.ndarray") -> None:
+        """Persist block-major host bytes ([n, L, ps, 2Hk, Dhp]) keyed by the
+        chained hashes. Called off the engine hot loop (retirement path)."""
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- lifecycle
+    def request_finished(self, request_id: str) -> None:  # pragma: no cover
+        pass
+
+
+_REGISTRY: dict[str, Callable[[Optional[dict]], KVConnectorBase]] = {}
+
+
+def register_kv_connector(name: str,
+                          factory: Callable[[Optional[dict]], KVConnectorBase]) -> None:
+    _REGISTRY[name] = factory
+
+
+def build_kv_connector(name: str, params: Optional[dict] = None) -> KVConnectorBase:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown KV connector {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](params)
+
+
+class InMemoryKVConnector(KVConnectorBase):
+    """Reference connector: a process-local dict store (what LMCache would be
+    with its engine replaced by a dict). Ships in-tree as the worked example
+    and CI-testable stand-in for external engines."""
+
+    def __init__(self, params: Optional[dict] = None) -> None:
+        super().__init__(params)
+        self.store: dict[int, np.ndarray] = {}
+        self.stats = {"saved_blocks": 0, "loaded_blocks": 0, "lookups": 0}
+
+    def get_num_matched_blocks(self, block_hashes: list[int]) -> int:
+        self.stats["lookups"] += 1
+        n = 0
+        for h in block_hashes:
+            if h not in self.store:
+                break
+            n += 1
+        return n
+
+    def load_blocks(self, cache, block_hashes, page_ids, pages_per_layer):
+        from llmd_tpu.disagg.transfer import insert_blocks
+
+        have = [h for h in block_hashes if h in self.store]
+        have = have[: len(page_ids)]
+        if not have:
+            return cache, 0
+        blocks = np.stack([self.store[h] for h in have])
+        cache = insert_blocks(cache, page_ids[: len(have)], blocks, pages_per_layer)
+        self.stats["loaded_blocks"] += len(have)
+        return cache, len(have)
+
+    def save_blocks(self, block_hashes, token_chunks, blocks) -> None:
+        for h, b in zip(block_hashes, blocks):
+            self.store[h] = np.array(b)
+        self.stats["saved_blocks"] += len(block_hashes)
+
+
+register_kv_connector("in-memory", InMemoryKVConnector)

@@ -35,6 +35,22 @@ class ModelConfig:
     # Dual-batch overlap: split MoE tokens into two independent half-batches so XLA
     # overlaps one half's all-to-all with the other's expert GEMMs (--enable-dbo).
     moe_dbo: bool = False
+    # Multimodal (vision tower): 0 mm_tokens = text-only. Each media item
+    # contributes exactly mm_tokens placeholder positions (id mm_placeholder_id)
+    # whose embeddings are injected from the encode stage — the E/PD contract
+    # (guides/multimodal-serving/e-disaggregation/README.md: encode workers
+    # produce embeddings consumed by prefill/decode alongside text tokens).
+    mm_tokens: int = 0
+    mm_placeholder_id: int = 0
+    vision_patch: int = 8  # square patch edge (pixels)
+    vision_image_size: int = 32  # inputs resized/cropped to this square edge
+    vision_layers: int = 0
+    vision_hidden: int = 0
+    vision_heads: int = 4
+
+    @property
+    def has_vision(self) -> bool:
+        return self.mm_tokens > 0 and self.vision_layers > 0
 
     @property
     def jax_dtype(self):

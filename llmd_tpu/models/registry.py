@@ -16,6 +16,14 @@ MODEL_REGISTRY: dict[str, ModelConfig] = {
         name="tiny", vocab_size=288, hidden_size=128, intermediate_size=384,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
     ),
+    # VL shape for the encode-disagg (E/PD) path: tiny text stack + a real
+    # (random-init) vision tower; 4 embedding tokens per media item.
+    "tiny-vl": ModelConfig(
+        name="tiny-vl", vocab_size=288, hidden_size=128, intermediate_size=384,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+        mm_tokens=4, mm_placeholder_id=287, vision_patch=8, vision_image_size=32,
+        vision_layers=2, vision_hidden=64, vision_heads=4,
+    ),
     "tiny-moe": ModelConfig(
         name="tiny-moe", vocab_size=288, hidden_size=128, intermediate_size=256,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,

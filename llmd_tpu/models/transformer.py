@@ -368,6 +368,8 @@ def forward_core(
     moe_matmul_impl=None,
     lora_indices: Optional[jax.Array] = None,  # [N] adapter slot per token (0 = none)
     lora_scale: float = 1.0,
+    mm_embeds: Optional[jax.Array] = None,  # [N, D] encode-stage rows, row-aligned
+    mm_mask: Optional[jax.Array] = None,  # [N] True where tokens[i] is a placeholder
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run a flat mixed batch through the model, writing K/V into the paged cache.
 
@@ -389,6 +391,10 @@ def forward_core(
     if attn_impl is None:
         attn_impl = ragged_paged_attention_xla
     x = params["embed"][tokens].astype(cfg.jax_dtype)  # [N, D]
+    if mm_embeds is not None:
+        # inject the encode stage's embedding rows at media placeholder
+        # positions (E/PD contract: encode workers produce, prefill consumes)
+        x = jnp.where(mm_mask[:, None], mm_embeds.astype(x.dtype), x)
 
     # global slot ids for the new tokens: page_table[seq, pos // ps] * ps + pos % ps
     b = jnp.clip(seq_slots, 0, B - 1)

@@ -36,7 +36,8 @@ from llmd_tpu.router.flowcontrol import FlowController
 from llmd_tpu.router.scheduler import Scheduler
 from llmd_tpu.router.scorers import STATE_TOKEN_IDS
 
-GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/embeddings")
+GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/embeddings",
+             "/v1/responses")
 
 
 @dataclass
@@ -51,10 +52,24 @@ class Rejection:
 
 
 def parse_openai_request(path: str, body: dict, headers: dict[str, str]) -> InferenceRequest:
-    """openai-parser (request-handling.md:50-73)."""
+    """openai-parser (request-handling.md:50-73): /completions, /chat/completions,
+    /embeddings, /responses, /conversations."""
     req = InferenceRequest.from_headers(headers)
     req.model = str(body.get("model", ""))
-    if "messages" in body:
+    if path.endswith("/v1/responses"):
+        # Responses API: input is str | [{role, content}] (epp-http-apis.md:153)
+        inp = body.get("input", "")
+        if isinstance(inp, list):
+            req.messages = [
+                {"role": it.get("role", "user"), "content": it.get("content", "")}
+                for it in inp if isinstance(it, dict)
+            ]
+            from llmd_tpu.core.request import mm_hashes_from_messages
+
+            req.mm_hashes = mm_hashes_from_messages(req.messages)
+        else:
+            req.prompt = str(inp)
+    elif "messages" in body:
         req.messages = body["messages"]
         from llmd_tpu.core.request import mm_hashes_from_messages
 
@@ -66,12 +81,33 @@ def parse_openai_request(path: str, body: dict, headers: dict[str, str]) -> Infe
         req.prompt = str(body.get("prompt", ""))
     req.lora_adapter = body.get("lora_adapter")
     req.sampling = SamplingParams(
-        max_tokens=int(body.get("max_tokens", 16)),
+        max_tokens=int(body.get("max_output_tokens", body.get("max_tokens", 16))),
         temperature=float(body.get("temperature", 1.0)),
     )
     req.streaming = bool(body.get("stream", False))
     req.byte_size = len(json.dumps(body))
     return req
+
+
+def parse_passthrough_request(path: str, body: dict, headers: dict[str, str]) -> InferenceRequest:
+    """passthrough-parser (request-handling.md:75): model-agnostic — content is
+    NOT interpreted, so payload-driven plugins (prefix scorers, token producer)
+    see an empty prompt and score nothing; routing runs on pool state alone.
+    Model/objective still come from headers so objective priorities apply."""
+    req = InferenceRequest.from_headers(headers)
+    lower = {k.lower(): v for k, v in headers.items()}
+    req.model = lower.get("x-model", "")
+    try:
+        req.byte_size = len(json.dumps(body))
+    except (TypeError, ValueError):
+        req.byte_size = 0
+    return req
+
+
+PARSERS = {
+    "openai-parser": parse_openai_request,
+    "passthrough-parser": parse_passthrough_request,
+}
 
 
 class RouterServer:
@@ -118,6 +154,12 @@ class RouterServer:
             )
         self.objectives = objectives or {}
         self.model_rewrites = model_rewrites or {}
+        # Request parser (request-handling.md:73-75): openai-parser default;
+        # passthrough-parser routes without payload interpretation.
+        parser_name = (config.raw.get("parser") if config.raw else None) or "openai-parser"
+        if parser_name not in PARSERS:
+            raise ValueError(f"unknown parser {parser_name!r}; known: {sorted(PARSERS)}")
+        self._parser = PARSERS[parser_name]
         # Scheduling runs off the event loop on ONE worker thread: plugins may block
         # (sidecar predictor RPC) and share per-request mutable state — a single
         # thread keeps them serialized while the proxy loop stays responsive.
@@ -159,6 +201,11 @@ class RouterServer:
         app = web.Application(client_max_size=64 * 1024 * 1024)
         for path in GEN_PATHS:
             app.router.add_post(path, self._handle_generate)
+        # Conversations API: pod-local state, so traffic is sticky by id —
+        # hash(cid) picks the pod deterministically on every EPP replica
+        app.router.add_post("/v1/conversations", self._handle_conversation)
+        app.router.add_route("*", "/v1/conversations/{tail:.*}",
+                             self._handle_conversation)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
         app.router.add_get("/v1/models", self._models)
@@ -208,7 +255,7 @@ class RouterServer:
         """Parse + apply objectives and model rewrite (mutates ``body`` on
         rewrite). Shared preamble of the standalone HTTP path and the
         gateway-mode ext-proc path."""
-        req = parse_openai_request(path, body, headers)
+        req = self._parser(path, body, headers)
         lower = {k.lower(): v for k, v in headers.items()}
         req.request_id = lower.get("x-request-id", uuid.uuid4().hex)
         if req.objective and req.objective in self.objectives:
@@ -245,6 +292,58 @@ class RouterServer:
             return None, Rejection(503, f"no endpoint: {result.rejected}")
         return result, None
 
+    def _sticky_endpoint(self, conversation_id: str):
+        """Conversation→pod mapping: rendezvous (highest-random-weight) hashing,
+        identical on every replica AND stable under pool changes — adding or
+        removing a pod only remaps the conversations that pod itself owned,
+        never the rest (a modulo scheme would 404 nearly every live
+        conversation on any scale event)."""
+        import hashlib as _h
+
+        eps = self.pool.list()
+        if not eps:
+            return None
+        cid = conversation_id.encode()
+        return max(eps, key=lambda e: _h.sha256(cid + b"@" + e.address.encode()).digest())
+
+    async def _forward_sticky(self, target, method: str, path: str, body,
+                              timeout_s: float):
+        """Proxy one request to its sticky pod, echoing the pick header."""
+        try:
+            resp = await self._session.request(
+                method, f"http://{target.address}{path}",
+                json=body, timeout=aiohttp.ClientTimeout(total=timeout_s))
+            payload = await resp.read()
+        except Exception as e:
+            self.metrics["errors_total"] += 1
+            return web.json_response(
+                {"error": {"message": f"upstream error: {e}"}}, status=502)
+        return web.Response(body=payload, status=resp.status,
+                            content_type=resp.content_type,
+                            headers={"x-llm-d-endpoint": target.address})
+
+    async def _handle_conversation(self, request: web.Request):
+        """Forward Conversations API traffic to its sticky pod. Creation gets a
+        router-assigned id so the hash mapping exists before any pod is asked."""
+        self.metrics["requests_total"] += 1
+        body = None
+        if request.method == "POST":
+            try:
+                body = await request.json() if request.can_read_body else {}
+            except Exception:
+                return web.json_response({"error": {"message": "invalid JSON"}},
+                                         status=400)
+        tail = request.match_info.get("tail", "")
+        cid = tail.split("/", 1)[0] if tail else None
+        if cid is None:  # create
+            body = dict(body or {})
+            cid = body.setdefault("id", f"conv_{uuid.uuid4().hex[:12]}")
+        target = self._sticky_endpoint(cid)
+        if target is None:
+            return web.json_response({"error": {"message": "no endpoints"}}, status=503)
+        return await self._forward_sticky(target, request.method, request.path,
+                                          body, timeout_s=60)
+
     async def _handle_generate(self, request: web.Request):
         t_start = time.monotonic()
         self.metrics["requests_total"] += 1
@@ -253,6 +352,15 @@ class RouterServer:
         except Exception:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
         headers = dict(request.headers)
+        # /v1/responses continuing a conversation must land on the pod holding
+        # that conversation's items (and its KV prefix)
+        if request.path.endswith("/v1/responses") and body.get("conversation"):
+            target = self._sticky_endpoint(str(body["conversation"]))
+            if target is None:
+                return web.json_response({"error": {"message": "no endpoints"}},
+                                         status=503)
+            return await self._forward_sticky(target, "POST", request.path, body,
+                                              timeout_s=600)
         req = self.prepare_request(request.path, body, headers)
 
         from llmd_tpu.obs.tracing import extract_traceparent

@@ -140,4 +140,28 @@ def test_pipeline_off_config_still_supported():
     sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
     out, eng = _run(PROMPTS[:1], sp, False)
     assert len(out["req-0"]) == 8
-    assert eng._pending_decode is None
+    assert not eng._pending_decode
+
+
+def test_no_orphaned_inflight_calls_on_membership_change():
+    """Regression: a membership-change flush must not strand the freshly
+    dispatched call in the drained queue (every launch gets processed)."""
+    from llmd_tpu.engine import EngineConfig, LLMEngine
+    from llmd_tpu.models import get_model_config
+
+    eng = LLMEngine(get_model_config("tiny"),
+                    EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                                 max_batch_size=4, prefill_chunk=32,
+                                 decode_steps=4, pipeline_decode=True))
+    # staggered lengths force repeated membership changes as sequences retire
+    for i, mt in enumerate((6, 14, 26)):
+        eng.add_request(f"r{i}", PROMPTS[i % len(PROMPTS)],
+                        SamplingParams(max_tokens=mt, temperature=0.0,
+                                       ignore_eos=True))
+    got = {f"r{i}": 0 for i in range(3)}
+    while eng.has_work():
+        for out in eng.step():
+            got[out.request_id] += len(out.new_token_ids)
+    assert got == {"r0": 6, "r1": 14, "r2": 26}
+    assert eng.stats.n_decode_dispatches == eng.stats.n_decode_calls
+    assert not eng._pending_decode

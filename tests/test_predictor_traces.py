@@ -1,0 +1,133 @@
+"""Predictor validated on ENGINE-EMITTED traces (VERDICT r3 directive #9).
+
+The synthetic-world test (test_predictor.py) proves the learner; this file
+closes the loop the reference closes on live traffic (latency-predictor.md:58):
+the serving engine emits (pod-state features, observed TTFT/TPOT) rows for every
+completed request, and the GBDT trained on one slice of those rows must predict
+a held-out slice better than a constant-mean baseline.
+
+CI runs on a CPU engine whose absolute latencies jitter with machine load, so
+the assertions are about *skill* (beat the mean predictor) plus a generous
+absolute MAPE ceiling — the ~5% reference bar applies to long-horizon traces on
+dedicated serving hardware, which a shared CI box cannot reproduce faithfully.
+"""
+
+import numpy as np
+
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine import EngineConfig, LLMEngine
+from llmd_tpu.models import get_model_config
+from llmd_tpu.predictor.model import LatencyModel, ttft_features
+from llmd_tpu.predictor.server import sample_from_dict
+
+
+def _trace_workload(seed: int = 0) -> list[dict]:
+    """Drive the engine through distinct load regimes and drain its trace.
+
+    Regimes vary the features the model must learn from: burst size (queue
+    depth / running count), prompt length (input_len), and repeated prompts
+    (prefix_match_pct) — each shifts observed TTFT in a learnable direction.
+    """
+    rng = np.random.default_rng(seed)
+    cfg = get_model_config("tiny")
+    eng = LLMEngine(cfg, EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                                      max_batch_size=4, prefill_chunk=32))
+    sp = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    rid = 0
+
+    def burst(n_reqs: int, prompt_len: int, shared_prefix: bool):
+        nonlocal rid
+        base = [int(t) for t in rng.integers(1, cfg.vocab_size - 1, prompt_len)]
+        if shared_prefix:
+            # seed the prefix cache first, THEN send the sharing burst — blocks
+            # only become reusable once the seeding request has computed them
+            eng.add_request(f"r{rid}", list(base), sp)
+            rid += 1
+            while eng.has_work():
+                eng.step()
+        for _ in range(n_reqs):
+            toks = list(base) if shared_prefix else [
+                int(t) for t in rng.integers(1, cfg.vocab_size - 1, prompt_len)]
+            eng.add_request(f"r{rid}", toks, sp)
+            rid += 1
+        while eng.has_work():
+            eng.step()
+
+    # interleave regimes so train/test splits see all of them
+    for rep in range(6):
+        burst(1, 24, False)           # idle pod, short prompt
+        burst(8, 24, False)           # deep queue → queued TTFT
+        burst(4, 96, False)           # long prompts → prefill-bound TTFT
+        burst(4, 96, True)            # shared prefix → cache-cut TTFT
+    return eng.drain_latency_trace()
+
+
+def test_engine_emits_latency_trace():
+    rows = _trace_workload()
+    assert len(rows) >= 100
+    r = rows[0]
+    for k in ("kv_usage", "input_len", "queue_depth", "running_requests",
+              "prefix_match_pct", "inflight_tokens", "tokens_generated", "ttft_ms"):
+        assert k in r, k
+    assert all(row["ttft_ms"] > 0 for row in rows)
+    assert any(row["tpot_ms"] is not None for row in rows)
+    assert any(row["prefix_match_pct"] > 0 for row in rows)  # shared-prefix regime
+    assert any(row["queue_depth"] >= 4 for row in rows)  # burst regime
+
+
+def test_model_beats_mean_on_engine_traces():
+    rows = _trace_workload()
+    samples = [sample_from_dict(r) for r in rows]
+    # interleaved split keeps every regime in both halves
+    train, test = samples[0::2] + samples[1::4], samples[3::4]
+    model = LatencyModel()
+    assert model.fit(train), f"needs >= {LatencyModel.MIN_SAMPLES} rows, got {len(train)}"
+
+    y = np.asarray([s.ttft_ms for s in test])
+    pred = np.asarray([p[0] for p in model.predict(test)])
+    mape = float(np.mean(np.abs(pred - y) / np.maximum(y, 1e-6)))
+    mean_mape = float(np.mean(np.abs(float(np.mean([s.ttft_ms for s in train])) - y)
+                              / np.maximum(y, 1e-6)))
+    print(f"engine-trace TTFT MAPE: model {mape:.3f} vs mean-baseline {mean_mape:.3f}")
+    assert mape < mean_mape, (mape, mean_mape)  # the model has skill on real traces
+    assert mape < 0.80  # CI-jitter-tolerant ceiling (reference bar ~5% on dedicated hw)
+
+
+def test_trace_rows_roundtrip_training_server(tmp_path):
+    """Server flow: EngineServer --POST /samples--> TrainingServer refit."""
+    import asyncio
+
+    import aiohttp
+
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.predictor.server import TrainingServer
+    from tests.conftest import run_async
+
+    async def scenario():
+        trainer = TrainingServer(str(tmp_path / "m.pkl"), retrain_interval_s=0.2)
+        await trainer.start()
+        cfg = get_model_config("tiny")
+        srv = EngineServer(cfg, EngineConfig(page_size=8, num_pages=64,
+                                             max_model_len=256, max_batch_size=4,
+                                             prefill_chunk=32),
+                           model_name="m", host="127.0.0.1", port=0,
+                           predictor_train_url=f"http://{trainer.address}")
+        await srv.start()
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for i in range(3):
+                    r = await sess.post(f"http://{srv.address}/v1/completions", json={
+                        "prompt": f"count to ten please {i}", "max_tokens": 4,
+                        "temperature": 0.0, "ignore_eos": True,
+                    })
+                    assert r.status == 200
+            for _ in range(80):  # flush loop runs at 1 Hz
+                if len(trainer.window) >= 3:
+                    break
+                await asyncio.sleep(0.1)
+            assert len(trainer.window) >= 3
+        finally:
+            await srv.stop()
+            await trainer.stop()
+
+    run_async(scenario())

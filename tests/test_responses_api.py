@@ -1,0 +1,186 @@
+"""OpenAI Responses + Conversations APIs and the parser registry (R3 parity).
+
+Reference: docs/api-reference/epp-http-apis.md:11,153-183 (the /v1/responses
+surface and shape) and request-handling.md:73-75 (openai-parser endpoint list,
+passthrough-parser semantics).
+"""
+
+import aiohttp
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.engine import EngineConfig
+from llmd_tpu.engine.server import EngineServer
+from llmd_tpu.models import get_model_config
+from llmd_tpu.router import plugins as _p  # noqa: F401
+from llmd_tpu.router import scorers as _s  # noqa: F401
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.server import (
+    RouterServer,
+    parse_openai_request,
+    parse_passthrough_request,
+)
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+from tests.conftest import run_async
+
+
+def _eng_cfg():
+    return EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                        max_batch_size=4, prefill_chunk=32)
+
+
+async def _responses_scenario():
+    srv = EngineServer(get_model_config("tiny"), _eng_cfg(), model_name="m",
+                       host="127.0.0.1", port=0)
+    await srv.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"http://{srv.address}/v1/responses", json={
+                "model": "m", "input": "Hello", "max_output_tokens": 5,
+                "temperature": 0.0, "ignore_eos": True,
+            })
+            assert r.status == 200
+            got = await r.json()
+            assert got["object"] == "response"
+            assert got["status"] == "incomplete"  # hit max_output_tokens
+            assert got["incomplete_details"] == {"reason": "max_output_tokens"}
+            assert got["usage"]["output_tokens"] == 5
+            msg = got["output"][0]
+            assert msg["type"] == "message" and msg["role"] == "assistant"
+            assert msg["content"][0]["type"] == "output_text"
+
+            # structured input form
+            r = await s.post(f"http://{srv.address}/v1/responses", json={
+                "model": "m", "max_output_tokens": 4, "temperature": 0.0,
+                "ignore_eos": True,
+                "input": [{"role": "user", "content": "first"},
+                          {"role": "user", "content": "second"}],
+            })
+            assert r.status == 200
+    finally:
+        await srv.stop()
+
+
+def test_responses_api():
+    run_async(_responses_scenario())
+
+
+async def _conversations_scenario():
+    srv = EngineServer(get_model_config("tiny"), _eng_cfg(), model_name="m",
+                       host="127.0.0.1", port=0)
+    await srv.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"http://{srv.address}/v1/conversations", json={})
+            conv = await r.json()
+            cid = conv["id"]
+            assert conv["object"] == "conversation"
+
+            # response bound to the conversation: exchange is stored
+            r = await s.post(f"http://{srv.address}/v1/responses", json={
+                "model": "m", "input": "remember the number 7",
+                "max_output_tokens": 4, "temperature": 0.0, "ignore_eos": True,
+                "conversation": cid,
+            })
+            assert r.status == 200
+            assert (await r.json())["conversation"] == cid
+            r = await s.get(f"http://{srv.address}/v1/conversations/{cid}/items")
+            items = (await r.json())["data"]
+            assert len(items) == 2  # user turn + assistant turn
+            assert items[0]["role"] == "user" and items[1]["role"] == "assistant"
+
+            # manual item append + unknown-conversation 404 + delete
+            r = await s.post(f"http://{srv.address}/v1/conversations/{cid}/items",
+                             json={"items": [{"role": "user", "content": "more"}]})
+            assert r.status == 200
+            r = await s.post(f"http://{srv.address}/v1/responses", json={
+                "model": "m", "input": "x", "conversation": "conv_nope"})
+            assert r.status == 404
+            r = await s.delete(f"http://{srv.address}/v1/conversations/{cid}")
+            assert (await r.json())["deleted"] is True
+            r = await s.get(f"http://{srv.address}/v1/conversations/{cid}")
+            assert r.status == 404
+    finally:
+        await srv.stop()
+
+
+def test_conversations_api():
+    run_async(_conversations_scenario())
+
+
+def test_parser_registry_and_passthrough():
+    req = parse_openai_request("/v1/responses", {
+        "model": "m", "input": "hi there", "max_output_tokens": 7}, {})
+    assert req.prompt == "hi there" and req.sampling.max_tokens == 7
+    req = parse_openai_request("/v1/responses", {
+        "model": "m", "input": [{"role": "user", "content": "structured"}]}, {})
+    assert req.messages and req.messages[0]["content"] == "structured"
+
+    req = parse_passthrough_request("/anything", {"prompt": "secret payload"},
+                                    {"x-model": "m2"})
+    assert req.model == "m2"
+    assert req.prompt is None or req.prompt == ""  # content NOT interpreted
+    assert not req.messages
+
+
+async def _router_responses_scenario():
+    """Router schedules /v1/responses like any generate path, and keeps
+    conversation traffic sticky to one pod across replicas."""
+    fakes = [FakeModelServer(FakeServerConfig()) for _ in range(3)]
+    engines = [EngineServer(get_model_config("tiny"), _eng_cfg(), model_name="m",
+                            host="127.0.0.1", port=0) for _ in range(2)]
+    for e in engines:
+        await e.start()
+    cfg_yaml = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+"""
+    def mk():
+        pool = EndpointPool()
+        for e in engines:
+            pool.upsert(Endpoint(address=e.address))
+        cfg = FrameworkConfig.from_yaml(cfg_yaml, known_types=known_plugin_types())
+        return RouterServer(cfg, pool, port=0, poll_interval_s=0.5)
+
+    ra, rb = mk(), mk()
+    await ra.start()
+    await rb.start()
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(f"http://{ra.address}/v1/responses", json={
+                "model": "m", "input": "through the router",
+                "max_output_tokens": 3, "temperature": 0.0, "ignore_eos": True})
+            assert r.status == 200
+            assert (await r.json())["object"] == "response"
+
+            r = await s.post(f"http://{ra.address}/v1/conversations", json={})
+            conv = await r.json()
+            cid = conv["id"]
+            created_on = r.headers["x-llm-d-endpoint"]
+            # both replicas + follow-up responses hit the SAME pod
+            for router in (ra, rb):
+                r = await s.get(f"http://{router.address}/v1/conversations/{cid}")
+                assert r.status == 200
+                assert r.headers["x-llm-d-endpoint"] == created_on
+            r = await s.post(f"http://{rb.address}/v1/responses", json={
+                "model": "m", "input": "follow up", "conversation": cid,
+                "max_output_tokens": 3, "temperature": 0.0, "ignore_eos": True})
+            assert r.status == 200
+            assert r.headers["x-llm-d-endpoint"] == created_on
+            r = await s.get(f"http://{ra.address}/v1/conversations/{cid}/items")
+            assert len((await r.json())["data"]) == 2
+    finally:
+        await ra.stop()
+        await rb.stop()
+        for e in engines:
+            await e.stop()
+        for f in fakes:
+            pass
+
+
+def test_router_responses_and_sticky_conversations():
+    run_async(_router_responses_scenario())
