@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Client-setup checker (A7): verify the tools a workstation needs to operate
+the stack, before any guide is attempted.
+
+The reference ships an install script for its CLI toolchain
+(/root/reference/helpers/client-setup/README.md); in this stack the client
+surface is Python, so the helper VERIFIES the environment (imports, native
+toolchain, optional extras) and prints exactly what is missing and why it
+matters. --strict exits non-zero for CI images.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import shutil
+import sys
+
+REQUIRED = [
+    ("jax", "engine + sharding dry-runs"),
+    ("numpy", "everything"),
+    ("aiohttp", "engine/router/sidecar HTTP servers"),
+    ("yaml", "router plugin-graph configs, manifests"),
+]
+OPTIONAL = [
+    ("zmq", "precise prefix routing (KV event subscription)"),
+    ("sklearn", "latency predictor (GBDT)"),
+    ("transformers", "HF tokenizer + checkpoint loading"),
+    ("grpc", "gateway mode (Envoy ext_proc)"),
+]
+TOOLS = [
+    ("g++", "native KV-transfer data plane build"),
+    ("kubectl", "applying deploy/ manifests (cluster use only)"),
+]
+
+
+def check() -> dict:
+    out = {"required": [], "optional": [], "tools": [], "ok": True}
+    for mod, why in REQUIRED:
+        ok = importlib.util.find_spec(mod) is not None
+        out["required"].append((mod, ok, why))
+        out["ok"] = out["ok"] and ok
+    for mod, why in OPTIONAL:
+        out["optional"].append((mod, importlib.util.find_spec(mod) is not None, why))
+    for tool, why in TOOLS:
+        out["tools"].append((tool, shutil.which(tool) is not None, why))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail when optional pieces are missing")
+    args = ap.parse_args()
+    res = check()
+    strict_ok = res["ok"]
+    for section, rows in (("required", res["required"]),
+                          ("optional", res["optional"]), ("tools", res["tools"])):
+        for name, ok, why in rows:
+            mark = "ok  " if ok else ("MISS" if section == "required" else "miss")
+            print(f"[{mark}] {section:8s} {name:14s} — {why}")
+            if not ok and section != "required" and args.strict:
+                strict_ok = False
+    print("client setup:", "OK" if (strict_ok if args.strict else res["ok"]) else "INCOMPLETE")
+    return 0 if (strict_ok if args.strict else res["ok"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Deployment smoke test: verify a serving stack is healthy end to end (A7).
+
+The TPU-stack analogue of the reference's healthcheck helper
+(/root/reference/helpers/smoke-test/README.md): liveness, readiness with model
+auto-discovery, and a real inference round trip with a latency bound — exit
+code 0/1 for CI gates, ``-o json`` for machine consumption. Pure stdlib, so it
+runs in any pod or laptop with Python (no curl/jq dependencies).
+
+Usage:
+  python helpers/smoke_test.py                         # localhost:8000
+  python helpers/smoke_test.py -e http://gw:80 -m m    # explicit endpoint/model
+  python helpers/smoke_test.py --api chat -l 5000      # chat path, 5s budget
+  python helpers/smoke_test.py -o json                 # CI output
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+
+def _get(url: str, timeout: float):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read() or b"{}")
+
+
+def _post(url: str, body: dict, timeout: float):
+    req = urllib.request.Request(
+        url, json.dumps(body).encode(), {"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def run_checks(endpoint: str, model: str | None, api: str, latency_ms: float,
+               require_health: bool, timeout: float, max_tokens: int = 8) -> dict:
+    results: dict = {"endpoint": endpoint, "checks": [], "ok": True}
+
+    def record(name: str, ok: bool, detail: str, ms: float | None = None):
+        results["checks"].append(
+            {"name": name, "ok": ok, "detail": detail, "latency_ms": ms})
+        if not ok:
+            results["ok"] = False
+
+    # liveness (optional — many gateways don't expose /health)
+    t0 = time.monotonic()
+    try:
+        status, _ = _get(f"{endpoint}/health", timeout)
+        record("health", status == 200, f"HTTP {status}",
+               (time.monotonic() - t0) * 1e3)
+    except Exception as e:
+        record("health", not require_health, f"unreachable: {e}")
+
+    # readiness + model discovery
+    t0 = time.monotonic()
+    try:
+        status, body = _get(f"{endpoint}/v1/models", timeout)
+        ids = [m.get("id") for m in body.get("data", [])]
+        ok = status == 200 and bool(ids)
+        record("models", ok, f"HTTP {status}, models={ids}",
+               (time.monotonic() - t0) * 1e3)
+        if model is None and ids:
+            model = ids[0]
+    except Exception as e:
+        record("models", False, f"unreachable: {e}")
+    if model is None:
+        record("inference", False, "no model discovered and none given (-m)")
+        return results
+
+    # end-to-end inference (with cross-API fallback, like the reference)
+    apis = [api] if api != "auto" else ["completions", "chat"]
+    for which in apis:
+        path = "/v1/chat/completions" if which == "chat" else "/v1/completions"
+        body = ({"model": model, "max_tokens": max_tokens, "temperature": 0.0,
+                 "messages": [{"role": "user", "content": "ping"}]}
+                if which == "chat" else
+                {"model": model, "max_tokens": max_tokens, "temperature": 0.0,
+                 "prompt": "ping"})
+        t0 = time.monotonic()
+        try:
+            status, resp = _post(f"{endpoint}{path}", body, timeout)
+            ms = (time.monotonic() - t0) * 1e3
+            choice = (resp.get("choices") or [{}])[0]
+            text = (choice.get("message") or {}).get("content") if which == "chat" \
+                else choice.get("text")
+            ok = status == 200 and text is not None
+            if ok and latency_ms and ms > latency_ms:
+                record(f"inference:{which}", False,
+                       f"latency {ms:.0f}ms > budget {latency_ms:.0f}ms", ms)
+            else:
+                record(f"inference:{which}", ok, f"HTTP {status}", ms)
+            if ok:
+                return results  # one working API suffices in auto mode
+        except Exception as e:
+            record(f"inference:{which}", False, f"error: {e}")
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-e", "--endpoint", default="http://localhost:8000")
+    ap.add_argument("-m", "--model", default=None)
+    ap.add_argument("--api", choices=["auto", "completions", "chat"], default="auto")
+    ap.add_argument("-l", "--latency-ms", type=float, default=0.0,
+                    help="fail if inference exceeds this (0 = no bound)")
+    ap.add_argument("--require-health", action="store_true")
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("-o", "--output", choices=["text", "json"], default="text")
+    args = ap.parse_args()
+
+    results = run_checks(args.endpoint.rstrip("/"), args.model, args.api,
+                         args.latency_ms, args.require_health, args.timeout)
+    if args.output == "json":
+        print(json.dumps(results))
+    else:
+        for c in results["checks"]:
+            mark = "PASS" if c["ok"] else "FAIL"
+            lat = f" ({c['latency_ms']:.0f} ms)" if c.get("latency_ms") else ""
+            print(f"[{mark}] {c['name']}: {c['detail']}{lat}")
+        print("smoke test:", "OK" if results["ok"] else "FAILED")
+    return 0 if results["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,13 +24,15 @@ HDR_SLO_TPOT_MS = "x-llm-d-slo-tpot-ms"
 HDR_PREFILLER_HOST_PORT = "x-prefiller-host-port"
 
 
-def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
-    """Content hash of one multimodal message part (image_url / input_audio...).
+def media_url_of_part(part: Any) -> "tuple[Optional[str], Optional[str]]":
+    """(kind, payload-url-or-data) of a media content part, else (None, None).
 
-    The reference folds these into KV block keys (kv-indexer.md:14,146-151) so two
-    prompts with different images never share cache entries."""
-    import hashlib
-
+    THE one media-kind→payload extraction — _mm_hash, the encode module's
+    is_media_part/media_bytes_from_part, and flatten rendering all build on it;
+    separate copies drifted once (router hashing media the engine rejected,
+    silently zeroing prefix-cache affinity) and must not exist again."""
+    if not isinstance(part, dict):
+        return None, None
     kind = part.get("type")
     if kind == "image_url":
         url = (part.get("image_url") or {}).get("url", "")
@@ -38,12 +40,32 @@ def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
         sub = part.get(kind) or {}
         url = sub.get("url", "") or sub.get("data", "")
     else:
+        return None, None
+    return (kind, str(url)) if url else (kind, None)
+
+
+def part_is_inline_media(part: Any) -> bool:
+    """True for parts the serving stack treats as media: inline ``data:`` URIs
+    (no egress — remote URLs are text from the cache's point of view)."""
+    _kind, url = media_url_of_part(part)
+    return url is not None and url.startswith("data:")
+
+
+def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
+    """Cache identity of one INLINE media part (image_url / input_audio...).
+
+    The reference folds these into KV block keys (kv-indexer.md:14,146-151) so
+    two prompts with different images never share cache entries. Only parts the
+    engine itself treats as media (part_is_inline_media) get an identity —
+    hashing anything broader breaks router↔engine key agreement."""
+    import hashlib
+
+    if not part_is_inline_media(part):
         return None
-    if not url:
-        return None
+    kind, url = media_url_of_part(part)
     # kind folds in: the same bytes as image vs video are different cache
     # identities (modality-specific encoders produce different embeddings)
-    return hashlib.sha256(f"{kind}:".encode() + str(url).encode()).digest()
+    return hashlib.sha256(f"{kind}:".encode() + url.encode()).digest()
 
 
 def flatten_messages(messages: Sequence[dict[str, Any]]) -> str:
@@ -70,9 +92,16 @@ def flatten_messages(messages: Sequence[dict[str, Any]]) -> str:
                 elif part.get("type") == "text":
                     pieces.append(part.get("text", ""))
                 else:
-                    h = _mm_hash(part)
-                    kind = part.get("type", "media")
-                    pieces.append(f"<{kind}:{h.hex()[:16]}>" if h else f"<{kind}>")
+                    # rendering identity covers ANY payload (remote URLs too —
+                    # different links must render differently); the mm
+                    # extra-key fold (_mm_hash) stays inline-media-only
+                    import hashlib as _hl
+
+                    kind, url = media_url_of_part(part)
+                    kind = kind or part.get("type", "media")
+                    pieces.append(
+                        f"<{kind}:{_hl.sha256(url.encode()).hexdigest()[:16]}>"
+                        if url else f"<{kind}>")
             content = " ".join(pieces)
         out.append(f"{m.get('role', '')}: {content}")
     return "\n".join(out)

@@ -43,18 +43,12 @@ from llmd_tpu.models.vision import (
 def is_media_part(part) -> bool:
     """Cheap media detection: inline ``data:`` URI of a known kind. Does NOT
     decode the payload — detection runs on event loops where materializing a
-    64 MB base64 body would stall every concurrent stream."""
-    if not isinstance(part, dict):
-        return False
-    kind = part.get("type")
-    if kind == "image_url":
-        url = (part.get("image_url") or {}).get("url", "")
-    elif kind in ("input_audio", "video_url", "audio_url"):
-        sub = part.get(kind) or {}
-        url = sub.get("url", "") or sub.get("data", "")
-    else:
-        return False
-    return isinstance(url, str) and url.startswith("data:")
+    64 MB base64 body would stall every concurrent stream. Delegates to the
+    ONE media predicate in core.request so router hashing and engine handling
+    can never disagree about what counts as media."""
+    from llmd_tpu.core.request import part_is_inline_media
+
+    return part_is_inline_media(part)
 
 
 def part_identity(part: dict) -> bytes:
@@ -84,16 +78,10 @@ def iter_media_parts(body: dict):
 def media_bytes_from_part(part: dict) -> Optional[bytes]:
     """OpenAI-style content part → raw media bytes (data: URIs only — this
     environment has no egress; remote URLs are the caller's job to inline)."""
-    if not isinstance(part, dict):
-        return None
-    kind = part.get("type")
-    if kind == "image_url":
-        url = (part.get("image_url") or {}).get("url", "")
-    elif kind in ("input_audio", "video_url", "audio_url"):
-        url = (part.get(kind) or {}).get("url", "") or (part.get(kind) or {}).get("data", "")
-    else:
-        return None
-    if isinstance(url, str) and url.startswith("data:"):
+    from llmd_tpu.core.request import media_url_of_part
+
+    _kind, url = media_url_of_part(part)
+    if url is not None and url.startswith("data:"):
         try:
             return base64.b64decode(url.split(",", 1)[1], validate=False)
         except (IndexError, binascii.Error):
@@ -211,7 +199,13 @@ class EncodeServer:
                     {"error": "unsupported media part (inline data: URIs only)"},
                     status=400)
             payloads.append(data)
-        encoded = self.runner_.encode(payloads)
+        import asyncio
+
+        # executor thread: the tower (jit compile on first call + device
+        # compute) must not block the worker's event loop — health probes and
+        # concurrent fan-out items keep flowing while this batch encodes
+        encoded = await asyncio.get_running_loop().run_in_executor(
+            None, self.runner_.encode, payloads)
         # wire identity = the canonical part hash (what router + engine fold
         # into block keys); the runner's content-hash only keys its own LRU
         return web.json_response(

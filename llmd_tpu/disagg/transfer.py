@@ -387,6 +387,37 @@ class KVTransferClient:
 # ---------------------------------------------------------------------------
 
 
+def stage_pages(cache, page_ids: list[int], pages_per_layer: int,
+                staging_pages: int = 16) -> list:
+    """Dispatch chunked device gathers of the given pages with async host-copy
+    hints; returns the in-flight device parts ([L, n_i, ps, 2Hk, Dhp] each).
+    Cheap (no sync) — safe under the engine lock; reads the cache value as of
+    dispatch, so later donated steps cannot corrupt the staging."""
+    import jax.numpy as jnp
+
+    L = cache.shape[0] // pages_per_layer
+    lrows = np.arange(L)[:, None]
+    parts: list = []
+    for i in range(0, len(page_ids), max(1, staging_pages)):
+        pg = np.asarray(page_ids[i : i + staging_pages], np.int32)
+        part = cache[jnp.asarray(lrows * pages_per_layer + pg[None, :])]
+        try:
+            part.copy_to_host_async()  # start D2H now; the drain happens later
+        except (AttributeError, RuntimeError):
+            pass
+        parts.append(part)
+    return parts
+
+
+def drain_staged(parts: list) -> np.ndarray:
+    """Blocking half: collect staged parts into one contiguous block-major
+    host buffer ([n, L, ps, 2Hk, Dhp]). Run OFF the engine lock."""
+    import jax
+
+    return np.ascontiguousarray(np.concatenate(
+        [np.moveaxis(np.asarray(jax.device_get(p)), 1, 0) for p in parts], axis=0))
+
+
 @dataclass
 class StagedExport:
     """In-flight device→host staging for one request's KV export.
@@ -411,8 +442,6 @@ def export_begin(engine, request_id: str, token_ids: list[int],
     chain and DISPATCH chunked device gathers with async host copies. The gathers
     read the cache value as of dispatch, so later steps/evictions can't corrupt
     the export — the runtime orders the donated step after these reads."""
-    import jax.numpy as jnp
-
     from llmd_tpu.core.kv_events import block_keys_for_tokens
 
     ps = engine.cfg.page_size
@@ -434,32 +463,15 @@ def export_begin(engine, request_id: str, token_ids: list[int],
     params = KVTransferParams(remote_request_id=request_id, num_blocks=len(pids))
     if not pids:
         return params, None
-    P = engine.cfg.num_pages
-    L = engine.cache.shape[0] // P
-    lrows = np.arange(L)[:, None]
-    parts: list[Any] = []
-    for i in range(0, len(pids), max(1, staging_pages)):
-        pg = np.asarray(pids[i : i + staging_pages], np.int32)
-        part = engine.cache[jnp.asarray(lrows * P + pg[None, :])]  # [L, n_i, ...]
-        try:
-            part.copy_to_host_async()  # start D2H now; fetch happens off-lock
-        except (AttributeError, RuntimeError):
-            pass
-        parts.append(part)
+    parts = stage_pages(engine.cache, pids, engine.cfg.num_pages, staging_pages)
     return params, StagedExport(request_id, hashes, chunks, parts)
 
 
 def export_finish(staged: StagedExport, source: KVTransferSource) -> int:
     """Phase 2 (engine lock NOT held): drain the staged copies into one
     contiguous block-major buffer and register the export. Returns blocks."""
-    import jax
-
-    blocks = np.concatenate(
-        [np.moveaxis(np.asarray(jax.device_get(p)), 1, 0) for p in staged.parts],
-        axis=0,
-    )
-    source.register(staged.request_id, staged.hashes, staged.chunks,
-                    np.ascontiguousarray(blocks))
+    blocks = drain_staged(staged.parts)
+    source.register(staged.request_id, staged.hashes, staged.chunks, blocks)
     return blocks.shape[0]
 
 

@@ -1173,34 +1173,24 @@ class LLMEngine:
         seq.finished = True
         seq.finish_reason = reason
         if self.kv_connector is not None and seq.block_hashes:
-            # K5 save path: DISPATCH the device gather here (cheap — reads the
-            # cache value as of now, ordering guaranteed vs later donated
-            # steps), then drain + hand bytes to the external engine on the
-            # connector thread, off the locked step loop (same staging shape as
-            # export_begin/export_finish).
+            # K5 save path: dispatch the chunked staging here (cheap, same
+            # helper as the P/D export path), drain + hand bytes to the
+            # external engine on the connector thread off the locked step loop.
             try:
-                import jax as _jax
-                import jax.numpy as _jnp
+                from llmd_tpu.disagg.transfer import drain_staged, stage_pages
 
                 n = len(seq.block_hashes)
                 ps = self.cfg.page_size
-                P = self.cfg.num_pages
-                L = self.cache.shape[0] // P
-                rows = np.arange(L)[:, None] * P + np.asarray(seq.pages[:n], np.int32)[None, :]
-                part = self.cache[_jnp.asarray(rows)]  # [L, n, ps, 2Hk, Dhp]
-                try:
-                    part.copy_to_host_async()
-                except (AttributeError, RuntimeError):
-                    pass
+                parts = stage_pages(self.cache, seq.pages[:n], self.cfg.num_pages,
+                                    self.cfg.offload_staging_blocks)
                 hashes = list(seq.block_hashes)
                 chunks = [seq.token_ids[i * ps : (i + 1) * ps] for i in range(n)]
                 rid = seq.request_id
 
-                def _drain(part=part, hashes=hashes, chunks=chunks, rid=rid):
+                def _drain(parts=parts, hashes=hashes, chunks=chunks, rid=rid):
                     try:
-                        blocks = np.ascontiguousarray(
-                            np.moveaxis(np.asarray(_jax.device_get(part)), 1, 0))
-                        self.kv_connector.save_blocks(hashes, chunks, blocks)
+                        self.kv_connector.save_blocks(hashes, chunks,
+                                                      drain_staged(parts))
                     except Exception:
                         pass  # external engine down: never fails serving
                     try:

@@ -17,17 +17,24 @@ def main() -> None:
                     help="registry name (llmd_tpu.models.MODEL_REGISTRY) or a local "
                          "HF checkpoint dir (config.json + safetensors)")
     ap.add_argument("--served-model-name", default=None)
+    # env-default ports: the container image / manifests configure pods via
+    # LLMD_TPU_* (deploy/ENV_VARS.md contract); flags still win when passed
     ap.add_argument("--host", default="0.0.0.0")
-    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("LLMD_TPU_PORT", "8000")))
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--num-pages", type=int, default=512)
     ap.add_argument("--max-model-len", type=int, default=2048)
     ap.add_argument("--max-batch-size", type=int, default=8)
     ap.add_argument("--prefill-chunk", type=int, default=128)
     ap.add_argument("--decode-steps", type=int, default=4)
-    ap.add_argument("--kv-events-port", type=int, default=None,
+    _env_kve = os.environ.get("LLMD_TPU_KV_EVENTS_PORT")
+    ap.add_argument("--kv-events-port", type=int,
+                    default=int(_env_kve) if _env_kve else None,
                     help="bind ZMQ KV-event PUB here (pod-discovery mode)")
-    ap.add_argument("--kv-transfer-port", type=int, default=None,
+    _env_kvt = os.environ.get("LLMD_TPU_KV_TRANSFER_PORT")
+    ap.add_argument("--kv-transfer-port", type=int,
+                    default=int(_env_kvt) if _env_kvt else None,
                     help="bind the P/D KV-transfer side channel here (0 = random; "
                          "TPU_KV_TRANSFER_PORT analogue, reference default 9100)")
     ap.add_argument("--advertise-host", default=None,

@@ -95,8 +95,15 @@ class InMemoryKVConnector(KVConnectorBase):
     def load_blocks(self, cache, block_hashes, page_ids, pages_per_layer):
         from llmd_tpu.disagg.transfer import insert_blocks
 
-        have = [h for h in block_hashes if h in self.store]
-        have = have[: len(page_ids)]
+        # CONSECUTIVE prefix only: the engine commits returned blocks under
+        # block_hashes[:n_loaded] positionally — skipping a missing middle
+        # block would commit wrong bytes under the wrong hash and silently
+        # poison the prefix cache for every future sharer
+        have: list[int] = []
+        for h in block_hashes[: len(page_ids)]:
+            if h not in self.store:
+                break
+            have.append(h)
         if not have:
             return cache, 0
         blocks = np.stack([self.store[h] for h in have])

@@ -307,12 +307,15 @@ class RouterServer:
         return max(eps, key=lambda e: _h.sha256(cid + b"@" + e.address.encode()).digest())
 
     async def _forward_sticky(self, target, method: str, path: str, body,
-                              timeout_s: float):
-        """Proxy one request to its sticky pod, echoing the pick header."""
+                              timeout_s: float,
+                              fwd_headers: Optional[dict] = None):
+        """Proxy one request to its sticky pod, echoing the pick header and
+        propagating trace/request-id headers."""
         try:
             resp = await self._session.request(
                 method, f"http://{target.address}{path}",
-                json=body, timeout=aiohttp.ClientTimeout(total=timeout_s))
+                json=body, headers=fwd_headers,
+                timeout=aiohttp.ClientTimeout(total=timeout_s))
             payload = await resp.read()
         except Exception as e:
             self.metrics["errors_total"] += 1
@@ -353,14 +356,36 @@ class RouterServer:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
         headers = dict(request.headers)
         # /v1/responses continuing a conversation must land on the pod holding
-        # that conversation's items (and its KV prefix)
+        # that conversation's items (and its KV prefix). Admission (flow
+        # control, objectives, tracing) still applies — sticky affinity only
+        # replaces the scheduler PICK, it is not a shedding bypass.
         if request.path.endswith("/v1/responses") and body.get("conversation"):
+            req = self.prepare_request(request.path, body, headers)
+            if self.flow:
+                outcome = await self.flow.enqueue_and_wait(req)
+                if outcome is not RequestOutcome.DISPATCHED:
+                    self.metrics["errors_total"] += 1
+                    return web.json_response(
+                        {"error": {"message": f"flow control: {outcome.value}"}},
+                        status=outcome.http_status)
             target = self._sticky_endpoint(str(body["conversation"]))
             if target is None:
                 return web.json_response({"error": {"message": "no endpoints"}},
                                          status=503)
-            return await self._forward_sticky(target, "POST", request.path, body,
-                                              timeout_s=600)
+            from llmd_tpu.obs.tracing import extract_traceparent
+
+            span = self.tracer.start_span(
+                "epp.request", parent=extract_traceparent(headers),
+                **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
+                   "http.route": request.path, "llm_d.sticky": True})
+            span.set_attribute("llm_d.endpoint", target.address)
+            resp = await self._forward_sticky(
+                target, "POST", request.path, body, timeout_s=600,
+                fwd_headers={"content-type": "application/json",
+                             "traceparent": span.traceparent(),
+                             "x-request-id": req.request_id})
+            span.end()
+            return resp
         req = self.prepare_request(request.path, body, headers)
 
         from llmd_tpu.obs.tracing import extract_traceparent

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Provision the llmd-tpu observability kit (A9) into a cluster:
+# - Grafana dashboards as labeled ConfigMaps (grafana sidecar auto-discovery)
+# - Prometheus alert rules as a ConfigMap
+#
+# Required environment variables:
+#  - NAMESPACE: target namespace for the ConfigMaps
+#
+# Usage:
+#   NAMESPACE=llm-d-monitoring ./observability/install.sh            # apply
+#   NAMESPACE=llm-d-monitoring ./observability/install.sh --dry-run  # render only
+set -euo pipefail
+
+HERE="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+NAMESPACE="${NAMESPACE:?set NAMESPACE to the monitoring namespace}"
+DRY_RUN="${1:-}"
+
+apply() {
+  if [[ "${DRY_RUN}" == "--dry-run" ]]; then
+    cat
+  else
+    kubectl apply -n "${NAMESPACE}" -f -
+  fi
+}
+
+for dash in "${HERE}"/grafana/*.json; do
+  name="llmd-tpu-dash-$(basename "${dash}" .json)"
+  kubectl create configmap "${name}" \
+    --from-file="$(basename "${dash}")=${dash}" \
+    --dry-run=client -o yaml \
+    | kubectl label --local -f - grafana_dashboard=1 --dry-run=client -o yaml \
+    | apply
+done
+
+kubectl create configmap llmd-tpu-alert-rules \
+  --from-file="alerts.yaml=${HERE}/alerts.yaml" \
+  --dry-run=client -o yaml \
+  | kubectl label --local -f - prometheus_rules=1 --dry-run=client -o yaml \
+  | apply
+
+echo "observability kit: $(ls "${HERE}"/grafana/*.json | wc -l) dashboards + alert rules -> namespace ${NAMESPACE} ${DRY_RUN}"

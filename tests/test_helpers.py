@@ -1,0 +1,59 @@
+"""A7 helpers: smoke test + client-setup checker drive against real servers."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+from llmd_tpu.engine import EngineConfig
+from llmd_tpu.engine.server import EngineServer
+from llmd_tpu.models import get_model_config
+from tests.conftest import run_async
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_smoke_test_against_live_engine():
+    async def main():
+        srv = EngineServer(get_model_config("tiny"),
+                           EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                                        max_batch_size=4, prefill_chunk=32),
+                           model_name="m", host="127.0.0.1", port=0)
+        await srv.start()
+        try:
+            import asyncio
+
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, str(ROOT / "helpers" / "smoke_test.py"),
+                "-e", f"http://{srv.address}", "-o", "json", "--require-health",
+                stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
+                env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
+                     "PYTHONPATH": str(ROOT)})
+            out, err = await proc.communicate()
+            results = json.loads(out)
+            assert results["ok"], results
+            names = [c["name"] for c in results["checks"]]
+            assert "health" in names and "models" in names
+            assert any(n.startswith("inference") for n in names)
+            assert proc.returncode == 0
+        finally:
+            await srv.stop()
+
+    run_async(main())
+
+
+def test_smoke_test_fails_cleanly_when_down():
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "helpers" / "smoke_test.py"),
+         "-e", "http://127.0.0.1:9", "-o", "json", "--timeout", "2"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert not json.loads(proc.stdout)["ok"]
+
+
+def test_client_setup_checker():
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "helpers" / "client_setup.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout
+    assert "client setup: OK" in proc.stdout

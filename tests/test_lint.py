@@ -1,0 +1,72 @@
+"""A8: the env-var contract linter runs in CI (tests are the CI here)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_envvar_contract_holds():
+    proc = subprocess.run([sys.executable, str(ROOT / "tools" / "lint_envvars.py")],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_linter_catches_undocumented_read(tmp_path):
+    """The linter detects drift: an undocumented os.environ read fails it.
+    (Its first real run caught 3 dead knobs shipped in the image.)"""
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import lint_envvars
+
+        src = lint_envvars.vars_read_in_source()
+        src["TOTALLY_UNDOCUMENTED_VAR"] = ["synthetic.py"]
+        orig = lint_envvars.vars_read_in_source
+        lint_envvars.vars_read_in_source = lambda: src
+        try:
+            errors = lint_envvars.lint()
+        finally:
+            lint_envvars.vars_read_in_source = orig
+        assert any("TOTALLY_UNDOCUMENTED_VAR" in e for e in errors)
+    finally:
+        sys.path.remove(str(ROOT / "tools"))
+
+
+def test_observability_kit_validates():
+    """A9: dashboards parse, reference only exported metric names, and the
+    alert rules file is structurally sound — hardware-free validation."""
+    import json
+    import re
+
+    import yaml
+
+    dash_dir = ROOT / "observability" / "grafana"
+    dashboards = sorted(dash_dir.glob("*.json"))
+    assert len(dashboards) >= 6  # parity with the reference's kit size
+
+    # metric names actually exported by the stack
+    exported = set()
+    for src in (ROOT / "llmd_tpu").rglob("*.py"):
+        exported |= set(re.findall(
+            r"(llmd_tpu:[a-z_]+|llm_d_epp_[a-z_]+|igw_[a-z_]+|vllm:[a-z_]+)",
+            src.read_text(errors="replace")))
+
+    metric_pat = re.compile(r"(llmd_tpu:[a-z_]+|llm_d_epp_[a-z_]+|igw_[a-z_]+|vllm:[a-z_]+)")
+    for dash in dashboards:
+        doc = json.loads(dash.read_text())
+        assert doc.get("uid") and doc.get("panels"), dash.name
+        for panel in doc["panels"]:
+            for tgt in panel.get("targets", []):
+                for m in metric_pat.findall(tgt["expr"]):
+                    assert m in exported, f"{dash.name}: unknown metric {m}"
+
+    rules = yaml.safe_load((ROOT / "observability" / "alerts.yaml").read_text())
+    names = set()
+    for group in rules["groups"]:
+        for rule in group["rules"]:
+            assert {"alert", "expr", "labels", "annotations"} <= set(rule), rule
+            names.add(rule["alert"])
+            for m in metric_pat.findall(rule["expr"]):
+                assert m in exported, f"alerts.yaml: unknown metric {m}"
+    assert len(names) >= 8

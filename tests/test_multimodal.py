@@ -377,3 +377,7 @@ def test_render_matches_generate_tokenization():
             await pd.stop()
 
     _run(main())
+
+
+def test_combined_pd_in_process_encode():
+    run_async(_combined_pd_scenario())
