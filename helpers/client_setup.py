@@ -12,7 +12,7 @@ matters. --strict exits non-zero for CI images.
 from __future__ import annotations
 
 import argparse
-import importlib
+import importlib.util
 import shutil
 import sys
 

@@ -71,8 +71,11 @@ def run_checks(endpoint: str, model: str | None, api: str, latency_ms: float,
         record("inference", False, "no model discovered and none given (-m)")
         return results
 
-    # end-to-end inference (with cross-API fallback, like the reference)
+    # end-to-end inference (with cross-API fallback, like the reference):
+    # ONE working API suffices in auto mode — earlier attempts' failures only
+    # count when every API fails
     apis = [api] if api != "auto" else ["completions", "chat"]
+    attempts: list[tuple[str, bool, str, float | None]] = []
     for which in apis:
         path = "/v1/chat/completions" if which == "chat" else "/v1/completions"
         body = ({"model": model, "max_tokens": max_tokens, "temperature": 0.0,
@@ -89,14 +92,17 @@ def run_checks(endpoint: str, model: str | None, api: str, latency_ms: float,
                 else choice.get("text")
             ok = status == 200 and text is not None
             if ok and latency_ms and ms > latency_ms:
-                record(f"inference:{which}", False,
-                       f"latency {ms:.0f}ms > budget {latency_ms:.0f}ms", ms)
+                attempts.append((which, False,
+                                 f"latency {ms:.0f}ms > budget {latency_ms:.0f}ms", ms))
             else:
-                record(f"inference:{which}", ok, f"HTTP {status}", ms)
-            if ok:
-                return results  # one working API suffices in auto mode
+                attempts.append((which, ok, f"HTTP {status}", ms))
         except Exception as e:
-            record(f"inference:{which}", False, f"error: {e}")
+            attempts.append((which, False, f"error: {e}", None))
+        if attempts[-1][1]:
+            record(f"inference:{which}", True, attempts[-1][2], attempts[-1][3])
+            return results
+    for which, ok, detail, ms in attempts:
+        record(f"inference:{which}", ok, detail, ms)
     return results
 
 

@@ -9,6 +9,7 @@ Parity targets:
 
 from __future__ import annotations
 
+import hashlib
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -56,13 +57,11 @@ def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
 
     The reference folds these into KV block keys (kv-indexer.md:14,146-151) so
     two prompts with different images never share cache entries. Only parts the
-    engine itself treats as media (part_is_inline_media) get an identity —
+    engine itself treats as media (inline data: URIs) get an identity —
     hashing anything broader breaks router↔engine key agreement."""
-    import hashlib
-
-    if not part_is_inline_media(part):
-        return None
     kind, url = media_url_of_part(part)
+    if url is None or not url.startswith("data:"):
+        return None
     # kind folds in: the same bytes as image vs video are different cache
     # identities (modality-specific encoders produce different embeddings)
     return hashlib.sha256(f"{kind}:".encode() + url.encode()).digest()
@@ -95,12 +94,10 @@ def flatten_messages(messages: Sequence[dict[str, Any]]) -> str:
                     # rendering identity covers ANY payload (remote URLs too —
                     # different links must render differently); the mm
                     # extra-key fold (_mm_hash) stays inline-media-only
-                    import hashlib as _hl
-
                     kind, url = media_url_of_part(part)
                     kind = kind or part.get("type", "media")
                     pieces.append(
-                        f"<{kind}:{_hl.sha256(url.encode()).hexdigest()[:16]}>"
+                        f"<{kind}:{hashlib.sha256(url.encode()).hexdigest()[:16]}>"
                         if url else f"<{kind}>")
             content = " ".join(pieces)
         out.append(f"{m.get('role', '')}: {content}")

@@ -94,11 +94,16 @@ class VisionRunner:
     combined-PD servers that encode in-process)."""
 
     def __init__(self, cfg: ModelConfig, cache_items: int = 256) -> None:
+        import threading
+
         import jax
 
         if not cfg.has_vision:
             raise ValueError(f"model {cfg.name!r} has no vision tower")
         self.cfg = cfg
+        # encode() runs on executor threads (the worker keeps its event loop
+        # free); the LRU + stats need the lock once calls overlap
+        self._lock = threading.Lock()
         seed = int.from_bytes(
             hashlib.sha256(f"vision:{cfg.name}".encode()).digest()[:4], "little")
         self.params = init_vision_params(cfg, jax.random.PRNGKey(seed))
@@ -111,24 +116,26 @@ class VisionRunner:
         """bytes per media item → [(content_hash, [mm_tokens, hidden] f32)]."""
         out: list[Optional[tuple[bytes, np.ndarray]]] = [None] * len(payloads)
         fresh: list[tuple[int, bytes, bytes]] = []  # (slot, hash, payload)
-        for i, data in enumerate(payloads):
-            h = mm_content_hash(data)
-            hit = self._lru.get(h)
-            if hit is not None:
-                self._lru.move_to_end(h)
-                self.stats["cache_hits"] += 1
-                out[i] = (h, hit)
-            else:
-                fresh.append((i, h, data))
+        with self._lock:
+            for i, data in enumerate(payloads):
+                h = mm_content_hash(data)
+                hit = self._lru.get(h)
+                if hit is not None:
+                    self._lru.move_to_end(h)
+                    self.stats["cache_hits"] += 1
+                    out[i] = (h, hit)
+                else:
+                    fresh.append((i, h, data))
         if fresh:
             px = np.stack([bytes_to_pixels(self.cfg, d) for _, _, d in fresh])
             emb = np.asarray(self._fn(px), np.float32)  # [n, mm_tokens, hidden]
-            for (i, h, _), e in zip(fresh, emb):
-                out[i] = (h, e)
-                self._lru[h] = e
-                if len(self._lru) > self._cache_items:
-                    self._lru.popitem(last=False)
-            self.stats["encoded_items"] += len(fresh)
+            with self._lock:
+                for (i, h, _), e in zip(fresh, emb):
+                    out[i] = (h, e)
+                    self._lru[h] = e
+                    if len(self._lru) > self._cache_items:
+                        self._lru.popitem(last=False)
+                self.stats["encoded_items"] += len(fresh)
         return out  # type: ignore[return-value]
 
 

@@ -20,15 +20,83 @@ from llmd_tpu.core.endpoint import Endpoint, EndpointPool, EndpointRole
 from llmd_tpu.core.metrics_contract import map_engine_metrics, parse_prometheus
 
 
+class Extractor:
+    """Polling-source extractor (datalayer.md 'Extractor' interface): transform
+    one endpoint's raw source payload into attributes on that endpoint."""
+
+    name = "extractor"
+
+    def extract(self, ep: Endpoint, raw) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class CoreMetricsExtractor(Extractor):
+    """core-metrics-extractor: engine-specific metric names → the standard
+    attribute keys scorers consume (kv_usage, waiting, running, ...), with
+    per-engine mapping so multiple inference engines coexist in one pool."""
+
+    name = "core-metrics-extractor"
+
+    def extract(self, ep: Endpoint, raw: list) -> None:
+        for k, v in map_engine_metrics(ep.engine_type, raw).items():
+            ep.attrs.put(k, v)
+
+
+class EndpointExtractor:
+    """Endpoint-lifecycle extractor (datalayer.md endpoint-notification-source
+    consumer): set up / tear down per-endpoint state as the pool changes."""
+
+    name = "endpoint-extractor"
+
+    def on_endpoint_added(self, ep: Endpoint) -> None:  # pragma: no cover
+        pass
+
+    def on_endpoint_removed(self, ep: Endpoint) -> None:  # pragma: no cover
+        pass
+
+
+class DataLayerRuntime:
+    """Source→extractor mapping + endpoint-event dispatch (datalayer.md
+    'Runtime'). Polling sources register their extractor chains here; endpoint
+    extractors bind to the pool's add/remove events."""
+
+    def __init__(self, pool: EndpointPool) -> None:
+        self.pool = pool
+        self.endpoint_extractors: list[EndpointExtractor] = []
+        pool.subscribe(self._on_pool_event)
+
+    def register_endpoint_extractor(self, ext: EndpointExtractor) -> None:
+        self.endpoint_extractors.append(ext)
+        for ep in self.pool.list():  # late registration sees existing members
+            ext.on_endpoint_added(ep)
+
+    def _on_pool_event(self, kind: str, ep: Endpoint) -> None:
+        for ext in self.endpoint_extractors:
+            try:
+                if kind == "added":
+                    ext.on_endpoint_added(ep)
+                elif kind == "removed":
+                    ext.on_endpoint_removed(ep)
+            except Exception:
+                pass  # one extractor's failure never starves the others
+
+
 class MetricsPoller:
-    """Polls every pool endpoint's Prometheus endpoint on an interval (HOT POLL)."""
+    """metrics-data-source + its extractor chain (HOT POLL).
+
+    Polls every pool endpoint's Prometheus endpoint on an interval and hands
+    the parsed samples to the registered extractors (CoreMetricsExtractor by
+    default; register more via ``extractors`` for derived attributes)."""
 
     def __init__(self, pool: EndpointPool, interval_s: float = 0.5,
-                 timeout_s: float = 2.0, metrics_path: str = "/metrics") -> None:
+                 timeout_s: float = 2.0, metrics_path: str = "/metrics",
+                 extractors: Optional[list[Extractor]] = None) -> None:
         self.pool = pool
         self.interval = interval_s
         self.timeout = aiohttp.ClientTimeout(total=timeout_s)
         self.metrics_path = metrics_path
+        self.extractors: list[Extractor] = (
+            list(extractors) if extractors is not None else [CoreMetricsExtractor()])
         self._task: Optional[asyncio.Task] = None
         self.poll_count = 0
         self.error_counts: dict[str, int] = {}
@@ -51,10 +119,20 @@ class MetricsPoller:
                     f"http://{ep.address}{self.metrics_path}", timeout=self.timeout
                 ) as resp:
                     text = await resp.text()
-                mapped = map_engine_metrics(ep.engine_type, parse_prometheus(text))
-                for k, v in mapped.items():
-                    ep.attrs.put(k, v)
-                ep.attrs.put("last_poll_ok", time.monotonic())
+                raw = parse_prometheus(text)
+                all_ok = True
+                for ext in self.extractors:
+                    try:
+                        ext.extract(ep, raw)
+                    except Exception:
+                        # a broken extractor never starves the rest, but the
+                        # failure stays VISIBLE: error counted, freshness stamp
+                        # withheld so staleness-aware consumers can react
+                        all_ok = False
+                        key = f"{ep.address}:{ext.name}"
+                        self.error_counts[key] = self.error_counts.get(key, 0) + 1
+                if all_ok:
+                    ep.attrs.put("last_poll_ok", time.monotonic())
             except Exception:
                 self.error_counts[ep.address] = self.error_counts.get(ep.address, 0) + 1
 

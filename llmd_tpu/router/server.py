@@ -263,6 +263,18 @@ class RouterServer:
         self._rewrite_model(req, body)
         return req
 
+    async def _flow_gate(self, req: InferenceRequest, span=None) -> Optional[Rejection]:
+        """Flow-control admission shared by the scheduled AND sticky paths."""
+        if self.flow:
+            if span:
+                span.add_event("flow_control.enqueue")
+            outcome = await self.flow.enqueue_and_wait(req)
+            if outcome is not RequestOutcome.DISPATCHED:
+                self.metrics["errors_total"] += 1
+                return Rejection(outcome.http_status,
+                                 f"flow control: {outcome.value}", deliberate=True)
+        return None
+
     async def admit_and_schedule(self, req: InferenceRequest, span=None):
         """Flow-control gate → async producers → scheduler pick.
 
@@ -271,15 +283,9 @@ class RouterServer:
         enforced admission decisions (load shedding, standby gating) that a
         FailOpen gateway must NOT bypass, vs EPP-can't-answer conditions
         (no endpoint) that failureMode may pass through."""
-        if self.flow:
-            if span:
-                span.add_event("flow_control.enqueue")
-            outcome = await self.flow.enqueue_and_wait(req)
-            if outcome is not RequestOutcome.DISPATCHED:
-                self.metrics["errors_total"] += 1
-                return None, Rejection(outcome.http_status,
-                                       f"flow control: {outcome.value}",
-                                       deliberate=True)
+        rej = await self._flow_gate(req, span)
+        if rej is not None:
+            return None, rej
         for p in self._async_producers:
             await p.aproduce(req, self.pool.list(), self._session)
         if span:
@@ -361,15 +367,13 @@ class RouterServer:
         # replaces the scheduler PICK, it is not a shedding bypass.
         if request.path.endswith("/v1/responses") and body.get("conversation"):
             req = self.prepare_request(request.path, body, headers)
-            if self.flow:
-                outcome = await self.flow.enqueue_and_wait(req)
-                if outcome is not RequestOutcome.DISPATCHED:
-                    self.metrics["errors_total"] += 1
-                    return web.json_response(
-                        {"error": {"message": f"flow control: {outcome.value}"}},
-                        status=outcome.http_status)
+            rej = await self._flow_gate(req)
+            if rej is not None:
+                return web.json_response({"error": {"message": rej.message}},
+                                         status=rej.status)
             target = self._sticky_endpoint(str(body["conversation"]))
             if target is None:
+                self.metrics["errors_total"] += 1
                 return web.json_response({"error": {"message": "no endpoints"}},
                                          status=503)
             from llmd_tpu.obs.tracing import extract_traceparent

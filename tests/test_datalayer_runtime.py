@@ -1,0 +1,98 @@
+"""Extractor DAG (R7, datalayer.md:5-91): pluggable Source→Extract→Attribute
+runtime — custom polling extractors and endpoint-lifecycle extractors."""
+
+import aiohttp
+
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.router.datalayer import (
+    CoreMetricsExtractor,
+    DataLayerRuntime,
+    EndpointExtractor,
+    Extractor,
+    MetricsPoller,
+)
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+from tests.conftest import run_async
+
+
+class SaturationExtractor(Extractor):
+    """Derived attribute built on top of the raw samples — the DAG property:
+    several extractors can consume ONE source's payload."""
+
+    name = "saturation-extractor"
+
+    def extract(self, ep, raw):
+        by_name = {n: v for n, _l, v in raw}
+        waiting = by_name.get("vllm:num_requests_waiting", 0.0)
+        kv = by_name.get("vllm:kv_cache_usage_perc", 0.0)
+        ep.attrs.put("saturated", waiting > 4 or kv > 0.9)
+
+
+class TrackingEndpointExtractor(EndpointExtractor):
+    name = "tracking"
+
+    def __init__(self):
+        self.events = []
+
+    def on_endpoint_added(self, ep):
+        self.events.append(("added", ep.address))
+        ep.attrs.put("tracked", True)
+
+    def on_endpoint_removed(self, ep):
+        self.events.append(("removed", ep.address))
+
+
+def test_polling_extractor_chain():
+    async def main():
+        fake = FakeModelServer(FakeServerConfig())
+        await fake.start()
+        try:
+            pool = EndpointPool()
+            pool.upsert(Endpoint(address=fake.address))
+            poller = MetricsPoller(
+                pool, extractors=[CoreMetricsExtractor(), SaturationExtractor()])
+            async with aiohttp.ClientSession() as s:
+                await poller.poll_once(s)
+            ep = pool.list()[0]
+            assert ep.attrs.get("total_queued_requests") is not None  # core ran
+            assert ep.attrs.get("saturated") is False  # derived extractor ran
+        finally:
+            await fake.stop()
+
+    run_async(main())
+
+
+def test_broken_extractor_never_starves_the_chain():
+    class Exploding(Extractor):
+        def extract(self, ep, raw):
+            raise RuntimeError("boom")
+
+    async def main():
+        fake = FakeModelServer(FakeServerConfig())
+        await fake.start()
+        try:
+            pool = EndpointPool()
+            pool.upsert(Endpoint(address=fake.address))
+            poller = MetricsPoller(
+                pool, extractors=[Exploding(), CoreMetricsExtractor()])
+            async with aiohttp.ClientSession() as s:
+                await poller.poll_once(s)
+            assert pool.list()[0].attrs.get("total_queued_requests") is not None
+        finally:
+            await fake.stop()
+
+    run_async(main())
+
+
+def test_endpoint_lifecycle_extractors():
+    pool = EndpointPool()
+    pool.upsert(Endpoint(address="10.0.0.1:8000"))  # pre-existing member
+    runtime = DataLayerRuntime(pool)
+    tracker = TrackingEndpointExtractor()
+    runtime.register_endpoint_extractor(tracker)
+    assert tracker.events == [("added", "10.0.0.1:8000")]  # late reg sees it
+    pool.upsert(Endpoint(address="10.0.0.2:8000"))
+    pool.remove("10.0.0.1:8000")
+    assert tracker.events[1:] == [("added", "10.0.0.2:8000"),
+                                  ("removed", "10.0.0.1:8000")]
+    assert pool.list()[0].attrs.get("tracked") is True

@@ -1,0 +1,88 @@
+"""Long-context serving (SURVEY §5 long-context row; VERDICT '262k-class').
+
+The real 262k-token runs are hardware-bound, but the MECHANISMS they rely on —
+many-chunk unified prefill, paged pools far larger than one batch, tiered
+offload under pool pressure, and the sp axis in the sharded program — must be
+exercised at meaningful depth in CI. These tests run the tiny model at
+thousands of tokens (hundreds of pages, dozens of prefill chunks) on CPU; the
+sp>1 execution itself is covered by __graft_entry__.dryrun_multichip.
+"""
+
+import numpy as np
+
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine import EngineConfig, LLMEngine
+from llmd_tpu.models import get_model_config
+
+CFG = get_model_config("tiny")
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [int(t) for t in rng.integers(1, CFG.vocab_size - 2, n)]
+
+
+def test_multi_thousand_token_prefill_decodes():
+    """A 1.5k-token prompt over 6 unified chunks and ~100 pages; generation
+    continues past the prompt. (Shapes sized to CPU compile budgets — the
+    8k+ shapes compile the same programs, just bigger.)"""
+    eng = LLMEngine(CFG, EngineConfig(page_size=16, num_pages=128,
+                                      max_model_len=2048, max_batch_size=2,
+                                      prefill_chunk=256,
+                                      max_num_batched_tokens=512,
+                                      decode_steps=8))
+    prompt = _prompt(1536)
+    out = {}
+    eng.add_request("long", prompt, SamplingParams(max_tokens=16, temperature=0.0,
+                                                   ignore_eos=True))
+    steps = 0
+    while eng.has_work():
+        for o in eng.step():
+            out.setdefault(o.request_id, []).extend(o.new_token_ids)
+        steps += 1
+    assert len(out["long"]) == 16
+    assert eng.stats.total_prefill_tokens == 1536
+    # chunked: prefill spanned many unified steps, not one giant batch
+    assert eng.stats.n_unified_steps >= 3
+    # deterministic across runs (no state corruption at depth)
+    eng2 = LLMEngine(CFG, EngineConfig(page_size=16, num_pages=128,
+                                       max_model_len=2048, max_batch_size=2,
+                                       prefill_chunk=256,
+                                       max_num_batched_tokens=512,
+                                       decode_steps=8))
+    eng2.add_request("long", list(prompt), SamplingParams(max_tokens=16,
+                                                          temperature=0.0,
+                                                          ignore_eos=True))
+    out2 = []
+    while eng2.has_work():
+        for o in eng2.step():
+            out2.extend(o.new_token_ids)
+    assert out2 == out["long"]
+
+
+def test_long_prefix_survives_offload_roundtrip():
+    """Long-context prefix reuse through the CPU tier: a 2k-token prefix gets
+    evicted under pool pressure, then a follow-up sharing it reloads from the
+    offload tier instead of recomputing everything."""
+    eng = LLMEngine(CFG, EngineConfig(page_size=16, num_pages=96,
+                                      max_model_len=2048, max_batch_size=2,
+                                      prefill_chunk=256,
+                                      max_num_batched_tokens=512,
+                                      cpu_offload_pages=256,
+                                      offload_watermark_pages=64,
+                                      offload_staging_blocks=32))
+    shared = _prompt(1024, seed=1)
+    sp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
+    eng.add_request("a", shared + _prompt(64, seed=2), sp)
+    while eng.has_work():
+        eng.step()
+    # churn the pool so the shared prefix demotes to the CPU tier
+    eng.add_request("churn", _prompt(1024, seed=3), sp)
+    while eng.has_work():
+        eng.step()
+    # follow-up sharing the long prefix: offload reloads beat recompute
+    eng.add_request("b", shared + _prompt(64, seed=4), sp)
+    while eng.has_work():
+        eng.step()
+    b = eng.seqs.get("b")
+    assert eng.stats.total_offload_loads > 0, "prefix must reload from the CPU tier"
