@@ -45,11 +45,16 @@ def media_url_of_part(part: Any) -> "tuple[Optional[str], Optional[str]]":
     return (kind, str(url)) if url else (kind, None)
 
 
+def _is_inline_payload(url: Optional[str]) -> bool:
+    """THE inline-media rule (one definition: every media predicate/identity
+    derives from it or router↔engine cache-key agreement drifts)."""
+    return url is not None and url.startswith("data:")
+
+
 def part_is_inline_media(part: Any) -> bool:
     """True for parts the serving stack treats as media: inline ``data:`` URIs
     (no egress — remote URLs are text from the cache's point of view)."""
-    _kind, url = media_url_of_part(part)
-    return url is not None and url.startswith("data:")
+    return _is_inline_payload(media_url_of_part(part)[1])
 
 
 def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
@@ -60,7 +65,7 @@ def _mm_hash(part: dict[str, Any]) -> Optional[bytes]:
     engine itself treats as media (inline data: URIs) get an identity —
     hashing anything broader breaks router↔engine key agreement."""
     kind, url = media_url_of_part(part)
-    if url is None or not url.startswith("data:"):
+    if not _is_inline_payload(url):
         return None
     # kind folds in: the same bytes as image vs video are different cache
     # identities (modality-specific encoders produce different embeddings)

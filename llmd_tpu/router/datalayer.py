@@ -63,22 +63,29 @@ class DataLayerRuntime:
     def __init__(self, pool: EndpointPool) -> None:
         self.pool = pool
         self.endpoint_extractors: list[EndpointExtractor] = []
+        self.error_counts: dict[str, int] = {}  # "<extractor>:<event>" → count
         pool.subscribe(self._on_pool_event)
 
     def register_endpoint_extractor(self, ext: EndpointExtractor) -> None:
         self.endpoint_extractors.append(ext)
         for ep in self.pool.list():  # late registration sees existing members
-            ext.on_endpoint_added(ep)
+            self._dispatch(ext, "added", ep)
+
+    def _dispatch(self, ext: EndpointExtractor, kind: str, ep: Endpoint) -> None:
+        try:
+            if kind == "added":
+                ext.on_endpoint_added(ep)
+            elif kind == "removed":
+                ext.on_endpoint_removed(ep)
+        except Exception:
+            # one extractor's failure never starves the others, but it stays
+            # VISIBLE — a silently-broken lifecycle extractor is undebuggable
+            key = f"{ext.name}:{kind}"
+            self.error_counts[key] = self.error_counts.get(key, 0) + 1
 
     def _on_pool_event(self, kind: str, ep: Endpoint) -> None:
         for ext in self.endpoint_extractors:
-            try:
-                if kind == "added":
-                    ext.on_endpoint_added(ep)
-                elif kind == "removed":
-                    ext.on_endpoint_removed(ep)
-            except Exception:
-                pass  # one extractor's failure never starves the others
+            self._dispatch(ext, kind, ep)
 
 
 class MetricsPoller:

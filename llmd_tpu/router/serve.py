@@ -45,6 +45,9 @@ def main() -> None:
     ap.add_argument("--extproc-port", type=int, default=None,
                     help="gateway mode: serve the Envoy ext_proc EPP gRPC here "
                          "(the HTTP port keeps serving /metrics and /health)")
+    ap.add_argument("--vllmgrpc-port", type=int, default=None,
+                    help="serve the vLLM gRPC API (Generate/Embed) here — the "
+                         "vllmgrpc-parser front, scheduled like HTTP traffic")
     ap.add_argument("--manifests", default=None,
                     help="InferencePool/InferenceObjective/InferenceModelRewrite/"
                          "VariantAutoscaling YAML (multi-doc)")
@@ -144,6 +147,12 @@ def main() -> None:
                              failure_mode=failure_mode)
             await epp.start()
             msg += f"; ext-proc EPP on grpc://{epp.address} ({failure_mode})"
+        if args.vllmgrpc_port is not None:
+            from llmd_tpu.router.vllmgrpc import VllmGrpcFront
+
+            vfront = VllmGrpcFront(server, host=args.host, port=args.vllmgrpc_port)
+            await vfront.start()
+            msg += f"; vllm-grpc on grpc://{vfront.address}"
         if elector is not None:
             msg += f"; HA role={'leader' if elector.is_leader else 'standby'}"
         print(msg, flush=True)

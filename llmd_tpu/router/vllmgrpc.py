@@ -1,0 +1,277 @@
+"""vllmgrpc parser front: the router's gRPC serving surface (R3 parity).
+
+The reference's EPP ships a ``vllmgrpc-parser`` handling the vLLM gRPC API's
+``Generate`` and ``Embed`` methods (request-handling.md:74). This module is
+that front for the TPU stack: a gRPC service (clean-room proto subset,
+protos/vllm_grpc.proto) that parses each RPC into an ``InferenceRequest``,
+runs the SAME admission pipeline as the HTTP and ext-proc fronts (flow
+control → async producers → Filter/Score/Pick), then proxies to the picked
+pod's OpenAI HTTP API and translates the answer back to protobuf — gRPC
+clients get scheduler-quality routing without the pods growing a gRPC port.
+
+Same generic-handler wiring as extproc.py (grpcio-tools isn't in the image, so
+no generated service stubs — the method handlers register explicitly under the
+full service name).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from concurrent import futures
+from typing import Optional
+
+import aiohttp
+import grpc
+
+from llmd_tpu.router import vllm_grpc_pb2 as pb
+from llmd_tpu.router.server import RouterServer
+
+SERVICE = "llmd.vllmgrpc.v1.VllmService"
+
+
+class VllmGrpcFront:
+    """gRPC front sharing one RouterServer's scheduling plane."""
+
+    def __init__(self, router: RouterServer, host: str = "127.0.0.1",
+                 port: int = 0, max_rpcs: int = 64) -> None:
+        self.router = router
+        self.host, self.port = host, port
+        self.max_rpcs = max_rpcs
+        self._server: Optional[grpc.Server] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.metrics = {"generate_total": 0, "embed_total": 0, "errors_total": 0}
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        """Await from the router's loop (admission is loop-bound)."""
+        self._loop = asyncio.get_running_loop()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_rpcs,
+                                       thread_name_prefix="vllmgrpc"),
+            maximum_concurrent_rpcs=self.max_rpcs,
+        )
+        handlers = {
+            "Generate": grpc.unary_stream_rpc_method_handler(
+                self._generate,
+                request_deserializer=pb.GenerateRequest.FromString,
+                response_serializer=pb.GenerateResponse.SerializeToString),
+            "Embed": grpc.unary_unary_rpc_method_handler(
+                self._embed,
+                request_deserializer=pb.EmbedRequest.FromString,
+                response_serializer=pb.EmbedResponse.SerializeToString),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+        self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
+        self._server.start()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+
+    # -- helpers -----------------------------------------------------------
+    def _await(self, coro, timeout: float = 600.0):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(timeout)
+
+    def _parse(self, path: str, body: dict) -> "object":
+        # one parser, one admission semantics with the HTTP front
+        return self.router.prepare_request(path, body, {})
+
+    @staticmethod
+    def _code_for(err) -> grpc.StatusCode:
+        """Rejection → gRPC status. 429 sheds map to RESOURCE_EXHAUSTED so
+        standard client retry policy backs off instead of hammering."""
+        return (grpc.StatusCode.RESOURCE_EXHAUSTED if err.status == 429
+                else grpc.StatusCode.UNAVAILABLE)
+
+    @staticmethod
+    def _fwd_headers(ireq, result) -> dict:
+        from llmd_tpu.core.request import HDR_PREFILLER_HOST_PORT
+
+        hdrs = {"x-request-id": ireq.request_id}
+        if result.prefill_endpoint is not None:
+            # P/D disaggregation rides this header through the pod's sidecar —
+            # dropping it silently degrades gRPC traffic to aggregated serving
+            hdrs[HDR_PREFILLER_HOST_PORT] = result.prefill_endpoint.address
+        return hdrs
+
+    async def _post_json(self, url: str, body: dict, headers: dict) -> dict:
+        async with self.router._session.post(
+            url, json=body, headers=headers,
+            timeout=aiohttp.ClientTimeout(total=600)) as resp:
+            text = await resp.text()
+            if resp.status != 200:
+                raise RuntimeError(f"upstream HTTP {resp.status}: {text[:200]}")
+            return json.loads(text)
+
+    # -- RPCs --------------------------------------------------------------
+    def _generate(self, req: pb.GenerateRequest, context):
+        self.metrics["generate_total"] += 1
+        body: dict = {
+            "model": req.model,
+            "max_tokens": int(req.sampling_params.max_tokens or 16),
+            "temperature": float(req.sampling_params.temperature),
+        }
+        if req.sampling_params.top_p:
+            body["top_p"] = float(req.sampling_params.top_p)
+        if req.sampling_params.top_k:
+            body["top_k"] = int(req.sampling_params.top_k)
+        if req.sampling_params.ignore_eos:
+            body["ignore_eos"] = True
+        if req.sampling_params.stop:
+            body["stop"] = list(req.sampling_params.stop)
+        if req.lora_adapter:
+            body["lora_adapter"] = req.lora_adapter
+        if req.WhichOneof("input") == "prompt_token_ids":
+            body["prompt_token_ids"] = list(req.prompt_token_ids.values)
+        else:
+            body["prompt"] = req.prompt
+
+        ireq = self._parse("/v1/completions", body)
+        import time
+
+        t0 = time.monotonic()
+        try:
+            result, err = self._await(self.router.admit_and_schedule(ireq))
+        except Exception as e:
+            self.metrics["errors_total"] += 1
+            context.abort(grpc.StatusCode.INTERNAL, f"EPP error: {e}")
+            return
+        if err is not None:
+            self.metrics["errors_total"] += 1
+            context.abort(self._code_for(err), err.message)
+            return
+        target = result.endpoint
+        rid = req.request_id or ireq.request_id
+        hdrs = self._fwd_headers(ireq, result)
+
+        if req.stream:
+            yield from self._generate_streaming(req, body, ireq, result, rid,
+                                                hdrs, context, t0)
+            return
+        try:
+            out = self._await(self._post_json(
+                f"http://{target.address}/v1/completions", body, hdrs))
+        except Exception as e:
+            self.metrics["errors_total"] += 1
+            self.router.scheduler.post_response(
+                ireq, target, {"status": 502, "error": str(e),
+                               "e2e_ms": (time.monotonic() - t0) * 1e3})
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"upstream: {e}")
+            return
+        usage = out.get("usage", {})
+        # the same response_info shape the HTTP front feeds the latency/SLO
+        # producers — gRPC traffic trains the predictor like any other
+        self.router.scheduler.post_response(ireq, target, {
+            "status": 200, "usage": usage,
+            "e2e_ms": (time.monotonic() - t0) * 1e3})
+        choice = (out.get("choices") or [{}])[0]
+        yield pb.GenerateResponse(
+            request_id=rid,
+            outputs=[pb.Completion(text=choice.get("text", ""),
+                                   finish_reason=choice.get("finish_reason") or "")],
+            finished=True,
+            usage=pb.UsageInfo(
+                prompt_tokens=int(usage.get("prompt_tokens", 0)),
+                completion_tokens=int(usage.get("completion_tokens", 0)),
+                cached_tokens=int(usage.get("cached_tokens", 0))),
+            endpoint=target.address,
+        )
+
+    def _generate_streaming(self, req, body, ireq, result, rid, hdrs,
+                            context, t0):
+        """stream=true: bridge the upstream SSE stream into the gRPC stream —
+        each data: chunk becomes one incremental GenerateResponse."""
+        import time
+
+        target = result.endpoint
+        agen = self._sse_chunks(
+            f"http://{target.address}/v1/completions", dict(body, stream=True),
+            hdrs)
+        usage: dict = {}
+        try:
+            while True:
+                chunk = self._await(agen.__anext__())
+                if chunk is None:
+                    break
+                choice = (chunk.get("choices") or [{}])[0]
+                usage = chunk.get("usage") or usage
+                yield pb.GenerateResponse(
+                    request_id=rid,
+                    outputs=[pb.Completion(
+                        text=choice.get("text", ""),
+                        finish_reason=choice.get("finish_reason") or "")],
+                    finished=bool(choice.get("finish_reason")),
+                    endpoint=target.address,
+                )
+        except StopAsyncIteration:
+            pass
+        except Exception as e:
+            self.metrics["errors_total"] += 1
+            self.router.scheduler.post_response(
+                ireq, target, {"status": 502, "error": str(e),
+                               "e2e_ms": (time.monotonic() - t0) * 1e3})
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"upstream: {e}")
+            return
+        self.router.scheduler.post_response(ireq, target, {
+            "status": 200, "usage": usage,
+            "e2e_ms": (time.monotonic() - t0) * 1e3})
+
+    async def _sse_chunks(self, url: str, body: dict, headers: dict):
+        """Async generator over the upstream SSE data: events (None at [DONE])."""
+        async with self.router._session.post(
+            url, json=body, headers=headers,
+            timeout=aiohttp.ClientTimeout(total=600)) as resp:
+            if resp.status != 200:
+                raise RuntimeError(f"upstream HTTP {resp.status}")
+            async for raw in resp.content:
+                line = raw.decode(errors="replace").strip()
+                if not line.startswith("data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == "[DONE]":
+                    yield None
+                    return
+                try:
+                    yield json.loads(payload)
+                except json.JSONDecodeError:
+                    continue
+        yield None
+
+    def _embed(self, req: pb.EmbedRequest, context):
+        import time
+
+        self.metrics["embed_total"] += 1
+        body = {"model": req.model, "input": req.input}
+        ireq = self._parse("/v1/embeddings", body)
+        t0 = time.monotonic()
+        try:
+            result, err = self._await(self.router.admit_and_schedule(ireq))
+        except Exception as e:
+            self.metrics["errors_total"] += 1
+            context.abort(grpc.StatusCode.INTERNAL, f"EPP error: {e}")
+        if err is not None:
+            self.metrics["errors_total"] += 1
+            context.abort(self._code_for(err), err.message)
+        target = result.endpoint
+        try:
+            out = self._await(self._post_json(
+                f"http://{target.address}/v1/embeddings", body,
+                self._fwd_headers(ireq, result)))
+        except Exception as e:
+            self.metrics["errors_total"] += 1
+            # release the inflight counters pre_request incremented
+            self.router.scheduler.post_response(
+                ireq, target, {"status": 502, "error": str(e),
+                               "e2e_ms": (time.monotonic() - t0) * 1e3})
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"upstream: {e}")
+        self.router.scheduler.post_response(ireq, target, {
+            "status": 200, "usage": out.get("usage", {}),
+            "e2e_ms": (time.monotonic() - t0) * 1e3})
+        emb = (out.get("data") or [{}])[0].get("embedding", [])
+        return pb.EmbedResponse(request_id=req.request_id or ireq.request_id,
+                                embedding=emb, endpoint=target.address)

@@ -1,0 +1,145 @@
+"""vllmgrpc parser front (R3, request-handling.md:74): Generate + Embed over
+gRPC ride the same admission/scheduling plane as the HTTP front."""
+
+import asyncio
+
+import grpc
+import pytest
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.engine import EngineConfig
+from llmd_tpu.engine.server import EngineServer
+from llmd_tpu.models import get_model_config
+from llmd_tpu.router import plugins as _p  # noqa: F401
+from llmd_tpu.router import scorers as _s  # noqa: F401
+from llmd_tpu.router import vllm_grpc_pb2 as pb
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.server import RouterServer
+from llmd_tpu.router.vllmgrpc import SERVICE, VllmGrpcFront
+from tests.conftest import run_async
+
+CFG_YAML = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+"""
+
+
+def _stub_methods(channel):
+    gen = channel.unary_stream(
+        f"/{SERVICE}/Generate",
+        request_serializer=pb.GenerateRequest.SerializeToString,
+        response_deserializer=pb.GenerateResponse.FromString)
+    emb = channel.unary_unary(
+        f"/{SERVICE}/Embed",
+        request_serializer=pb.EmbedRequest.SerializeToString,
+        response_deserializer=pb.EmbedResponse.FromString)
+    return gen, emb
+
+
+async def _scenario():
+    engines = [EngineServer(get_model_config("tiny"),
+                            EngineConfig(page_size=8, num_pages=64,
+                                         max_model_len=256, max_batch_size=4,
+                                         prefill_chunk=32),
+                            model_name="m", host="127.0.0.1", port=0)
+               for _ in range(2)]
+    for e in engines:
+        await e.start()
+    pool = EndpointPool()
+    for e in engines:
+        pool.upsert(Endpoint(address=e.address))
+    cfg = FrameworkConfig.from_yaml(CFG_YAML, known_types=known_plugin_types())
+    router = RouterServer(cfg, pool, port=0, poll_interval_s=0.5)
+    await router.start()
+    front = VllmGrpcFront(router, port=0)
+    await front.start()
+    try:
+        def client_calls():
+            with grpc.insecure_channel(front.address) as ch:
+                gen, emb = _stub_methods(ch)
+                req = pb.GenerateRequest(
+                    request_id="g-1", model="m", prompt="count to five",
+                    sampling_params=pb.SamplingParams(
+                        max_tokens=6, temperature=0.0, ignore_eos=True))
+                resps = list(gen(req, timeout=60))
+                assert len(resps) == 1 and resps[0].finished
+                assert resps[0].request_id == "g-1"
+                assert resps[0].usage.completion_tokens == 6
+                assert resps[0].endpoint  # routing echo present
+                first_ep = resps[0].endpoint
+
+                # pre-tokenized input form
+                req2 = pb.GenerateRequest(
+                    model="m",
+                    prompt_token_ids=pb.TokenIds(values=list(range(20, 40))),
+                    sampling_params=pb.SamplingParams(
+                        max_tokens=4, temperature=0.0, ignore_eos=True))
+                r2 = list(gen(req2, timeout=60))
+                assert r2[0].usage.completion_tokens == 4
+
+                e = emb(pb.EmbedRequest(request_id="e-1", model="m",
+                                        input="embed me"), timeout=60)
+                assert e.request_id == "e-1"
+                assert len(e.embedding) > 0
+
+                # streaming: incremental messages, final one carries a finish
+                sreq = pb.GenerateRequest(
+                    model="m", prompt="stream this", stream=True,
+                    sampling_params=pb.SamplingParams(
+                        max_tokens=5, temperature=0.0, ignore_eos=True))
+                msgs = list(gen(sreq, timeout=60))
+                assert len(msgs) >= 2  # tokens arrived incrementally
+                assert not msgs[0].finished
+                assert msgs[-1].finished
+                return first_ep
+
+        first_ep = await asyncio.get_running_loop().run_in_executor(
+            None, client_calls)
+        assert first_ep in {e.address for e in engines}
+        assert front.metrics["generate_total"] == 3
+        assert front.metrics["embed_total"] == 1
+        assert front.metrics["errors_total"] == 0
+    finally:
+        await front.stop()
+        await router.stop()
+        for e in engines:
+            await e.stop()
+
+
+def test_vllmgrpc_generate_and_embed():
+    run_async(_scenario())
+
+
+def test_vllmgrpc_rejects_with_grpc_status():
+    """Scheduling failure maps to a gRPC status code, not a hung stream."""
+
+    async def main():
+        pool = EndpointPool()  # EMPTY pool → no endpoint
+        cfg = FrameworkConfig.from_yaml(CFG_YAML, known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0, poll_interval_s=0.5)
+        await router.start()
+        front = VllmGrpcFront(router, port=0)
+        await front.start()
+        try:
+            def call():
+                with grpc.insecure_channel(front.address) as ch:
+                    gen, _ = _stub_methods(ch)
+                    with pytest.raises(grpc.RpcError) as exc:
+                        list(gen(pb.GenerateRequest(
+                            model="m", prompt="x",
+                            sampling_params=pb.SamplingParams(max_tokens=2)),
+                            timeout=30))
+                    assert exc.value.code() == grpc.StatusCode.UNAVAILABLE
+
+            await asyncio.get_running_loop().run_in_executor(None, call)
+            assert front.metrics["errors_total"] == 1
+        finally:
+            await front.stop()
+            await router.stop()
+
+    run_async(main())

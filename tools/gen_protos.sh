@@ -4,5 +4,5 @@
 # llmd_tpu/router/extproc.py instead of a generated stub).
 set -e
 cd "$(dirname "$0")/.."
-protoc --python_out=llmd_tpu/router --proto_path=protos protos/ext_proc.proto
-echo "wrote llmd_tpu/router/ext_proc_pb2.py"
+protoc --python_out=llmd_tpu/router --proto_path=protos protos/ext_proc.proto protos/vllm_grpc.proto
+echo "wrote llmd_tpu/router/{ext_proc,vllm_grpc}_pb2.py"
