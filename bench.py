@@ -94,7 +94,7 @@ def main() -> None:
         # (one host round trip instead of five; measured 196 ms/call at NT=2048
         # of which ~67 ms was the tunnel RTT). decode_steps=32 halves fused-call
         # count for the same reason. bench falls back to the r03-proven config
-        # if this one fails to build/serve (see run_measured below).
+        # if this one fails to build/serve (see build_and_measure fallback below).
         eng_cfg = EngineConfig(page_size=16, num_pages=2048, max_model_len=1024,
                                max_batch_size=32, prefill_chunk=256, decode_steps=32,
                                max_num_batched_tokens=8192, instrument=True)
@@ -169,6 +169,7 @@ def main() -> None:
         out = eng.generate(prompts(n_req, salt=2), sp)
         return eng, out, time.monotonic() - t0
 
+    primary_error = None
     try:
         eng, out, wall = build_and_measure(eng_cfg)
     except Exception as e:
@@ -177,13 +178,16 @@ def main() -> None:
         # r03-proven shape and measure that instead
         if tiny or args.batch or args.decode_steps:
             raise
-        print(f"# WARNING: primary config failed ({type(e).__name__}: {e}); "
+        # record and DROP the exception: its traceback pins the failed
+        # engine's device buffers alive, which would make an OOM-triggered
+        # fallback hit the same OOM
+        primary_error = f"{type(e).__name__}: {e}"
+    if primary_error is not None:
+        print(f"# WARNING: primary config failed ({primary_error}); "
               "falling back to NT=2048/k=16", file=sys.stderr)
-        from llmd_tpu.engine import EngineConfig as _EC
-
-        eng_cfg = _EC(page_size=16, num_pages=2048, max_model_len=1024,
-                      max_batch_size=32, prefill_chunk=256, decode_steps=16,
-                      max_num_batched_tokens=2048, instrument=True)
+        eng_cfg = EngineConfig(page_size=16, num_pages=2048, max_model_len=1024,
+                               max_batch_size=32, prefill_chunk=256, decode_steps=16,
+                               max_num_batched_tokens=2048, instrument=True)
         eng, out, wall = build_and_measure(eng_cfg)
     dev = jax.devices()[0]
     out_tokens = sum(len(v) for v in out.values())

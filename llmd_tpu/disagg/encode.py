@@ -218,3 +218,35 @@ class EncodeServer:
         return web.json_response(
             {"items": [mm_item_to_wire(part_identity(p), e)
                        for p, (_h, e) in zip(parts, encoded)]})
+
+
+def main() -> None:
+    """CLI: python -m llmd_tpu.disagg.encode --model tiny-vl --port 8001
+
+    Deployment entrypoint for an encode worker pod (the reference's
+    encode-deployment.yaml role, guides/multimodal-serving/e-disaggregation)."""
+    import argparse
+    import asyncio
+
+    from llmd_tpu.models import get_model_config
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny-vl",
+                    help="registry shape with a vision tower (mm_tokens > 0)")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8001)
+    args = ap.parse_args()
+
+    srv = EncodeServer(get_model_config(args.model), host=args.host, port=args.port)
+
+    async def run() -> None:
+        await srv.start()
+        print(f"llmd-tpu encode worker ({args.model}) on http://{srv.address}",
+              flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

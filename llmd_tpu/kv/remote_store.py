@@ -1,0 +1,247 @@
+"""Remote KV store over DCN: the InfiniStore/remote-LMCache role (N9/K5).
+
+A standalone content-addressed block store any engine reaches over TCP, behind
+the out-of-tree connector seam (kv/connector_api.py) — KV computed by one pod
+survives pod restarts and feeds OTHER pods' admissions, the cross-pod tier the
+reference gets from InfiniStore-backed LMCache (Dockerfile.cuda:55-59,
+kv-offloader.md:70-100). Design choices for this stack:
+
+- content-addressed by chained block hash (the same keys the prefix cache and
+  KV events use), so admission can ask for a consecutive chain directly;
+- framed wire protocol in the house style (MAGIC + JSON header + raw payload,
+  like disagg/transfer.py) — one long-lived store, many short-lived clients;
+- byte-budget LRU eviction server-side (external stores manage their own
+  capacity — the engine never has to care, matching the FS-backend contract).
+
+Wire protocol (request → response):
+  MAGIC ‖ u32 len ‖ JSON header ‖ payload?
+  ops: put   {hashes, dtype, shape, nbytes} + payload   → {stored}
+       get   {hashes}                  → {found, dtype, shape, nbytes} + payload
+       probe {hashes}                  → {found}         (consecutive prefix)
+       stats {}                        → counters
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from llmd_tpu.kv.connector_api import KVConnectorBase, register_kv_connector
+
+MAGIC = b"KVS1"
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = conn.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_frame(conn: socket.socket, header: dict, payload: bytes = b"") -> None:
+    hdr = json.dumps(header).encode()
+    conn.sendall(MAGIC + struct.pack("<I", len(hdr)) + hdr + payload)
+
+
+def _recv_frame(conn: socket.socket) -> tuple[dict, "socket.socket"]:
+    if _recv_exact(conn, 4) != MAGIC:
+        raise ConnectionError("bad magic")
+    (hlen,) = struct.unpack("<I", _recv_exact(conn, 4))
+    return json.loads(_recv_exact(conn, hlen)), conn
+
+
+class RemoteKVStoreServer:
+    """Content-addressed block store with a byte-budget LRU."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_bytes: int = 1 << 30) -> None:
+        self.host, self.port = host, port
+        self.max_bytes = max_bytes
+        self._blocks: OrderedDict[int, tuple[bytes, str, tuple]] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self._srv: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self.stats = {"puts": 0, "gets": 0, "probes": 0, "evictions": 0,
+                      "hit_blocks": 0, "miss_blocks": 0}
+
+    def start(self) -> None:
+        self._srv = socket.create_server((self.host, self.port))
+        self.port = self._srv.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="kv-store").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            self._srv.close()
+
+    # -- storage -----------------------------------------------------------
+    def _put(self, hashes: list[int], dtype: str, shape: tuple,
+             payload: bytes) -> int:
+        per = len(payload) // max(1, len(hashes))
+        with self._lock:
+            for i, h in enumerate(hashes):
+                if h in self._blocks:
+                    self._blocks.move_to_end(h)
+                    continue
+                blob = payload[i * per : (i + 1) * per]
+                self._blocks[h] = (blob, dtype, tuple(shape))
+                self._bytes += len(blob)
+            while self._bytes > self.max_bytes and self._blocks:
+                _h, (blob, _d, _s) = self._blocks.popitem(last=False)
+                self._bytes -= len(blob)
+                self.stats["evictions"] += 1
+            self.stats["puts"] += 1
+        return len(hashes)
+
+    def _prefix(self, hashes: list[int], touch: bool) -> list[int]:
+        """Consecutive found prefix (the only shape admission can commit)."""
+        out = []
+        with self._lock:
+            for h in hashes:
+                if h not in self._blocks:
+                    break
+                if touch:
+                    self._blocks.move_to_end(h)
+                out.append(h)
+        return out
+
+    # -- server loop -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_one(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                hdr, _ = _recv_frame(conn)
+                op = hdr.get("op")
+                if op == "put":
+                    payload = _recv_exact(conn, int(hdr["nbytes"]))
+                    n = self._put([int(h) for h in hdr["hashes"]],
+                                  hdr["dtype"], hdr["shape"], payload)
+                    _send_frame(conn, {"stored": n})
+                elif op in ("get", "probe"):
+                    hashes = [int(h) for h in hdr["hashes"]]
+                    have = self._prefix(hashes, touch=(op == "get"))
+                    self.stats["hit_blocks"] += len(have)
+                    self.stats["miss_blocks"] += len(hashes) - len(have)
+                    if op == "probe":
+                        self.stats["probes"] += 1
+                        _send_frame(conn, {"found": len(have)})
+                    else:
+                        self.stats["gets"] += 1
+                        with self._lock:
+                            blobs = [self._blocks[h] for h in have
+                                     if h in self._blocks]
+                        payload = b"".join(b for b, _d, _s in blobs)
+                        meta = blobs[0] if blobs else (b"", "float32", ())
+                        _send_frame(conn, {"found": len(blobs),
+                                           "dtype": meta[1],
+                                           "shape": list(meta[2]),
+                                           "nbytes": len(payload)}, payload)
+                elif op == "stats":
+                    with self._lock:
+                        _send_frame(conn, {**self.stats,
+                                           "blocks": len(self._blocks),
+                                           "bytes": self._bytes})
+                else:
+                    _send_frame(conn, {"error": f"unknown op {op!r}"})
+        except (ConnectionError, OSError, json.JSONDecodeError):
+            pass  # client vanished mid-op: next client gets a fresh thread
+
+
+class RemoteKVConnector(KVConnectorBase):
+    """Engine-side connector speaking the store protocol (registered as
+    ``remote-store``; EngineConfig.kv_connector_params = {host, port})."""
+
+    def __init__(self, params: Optional[dict] = None) -> None:
+        super().__init__(params)
+        p = self.params
+        self.host = p.get("host", "127.0.0.1")
+        self.port = int(p.get("port", 0))
+        self.timeout = float(p.get("timeout_s", 5.0))
+        self.stats = {"errors": 0}
+
+    def _rpc(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+        with socket.create_connection((self.host, self.port),
+                                      timeout=self.timeout) as conn:
+            _send_frame(conn, header, payload)
+            resp, _ = _recv_frame(conn)
+            body = _recv_exact(conn, int(resp["nbytes"])) if resp.get("nbytes") else b""
+            return resp, body
+
+    def get_num_matched_blocks(self, block_hashes: list[int]) -> int:
+        try:
+            resp, _ = self._rpc({"op": "probe", "hashes": block_hashes})
+            return int(resp.get("found", 0))
+        except (OSError, ConnectionError, KeyError, ValueError):
+            self.stats["errors"] += 1
+            return 0  # store down = no external hits; serving continues
+
+    def load_blocks(self, cache, block_hashes, page_ids, pages_per_layer):
+        from llmd_tpu.disagg.transfer import insert_blocks
+
+        want = block_hashes[: len(page_ids)]
+        try:
+            resp, body = self._rpc({"op": "get", "hashes": want})
+            n = int(resp.get("found", 0))
+            if n == 0:
+                return cache, 0
+            blocks = np.frombuffer(body, dtype=resp["dtype"]).reshape(
+                (n, *resp["shape"]))
+            cache = insert_blocks(cache, page_ids[:n], blocks, pages_per_layer)
+            return cache, n
+        except (OSError, ConnectionError, KeyError, ValueError):
+            self.stats["errors"] += 1
+            return cache, 0
+
+    def save_blocks(self, block_hashes, token_chunks, blocks) -> None:
+        arr = np.ascontiguousarray(blocks)
+        try:
+            self._rpc({"op": "put", "hashes": list(block_hashes),
+                       "dtype": str(arr.dtype), "shape": list(arr.shape[1:]),
+                       "nbytes": arr.nbytes}, arr.tobytes())
+        except (OSError, ConnectionError):
+            self.stats["errors"] += 1  # best-effort tier
+
+
+register_kv_connector("remote-store", RemoteKVConnector)
+
+
+def main() -> None:
+    """CLI: python -m llmd_tpu.kv.remote_store --port 9400 --max-gb 8"""
+    import argparse
+    import time
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=9400)
+    ap.add_argument("--max-gb", type=float, default=8.0)
+    args = ap.parse_args()
+    srv = RemoteKVStoreServer(args.host, args.port,
+                              max_bytes=int(args.max_gb * (1 << 30)))
+    srv.start()
+    print(f"llmd-tpu remote KV store on {srv.host}:{srv.port} "
+          f"({args.max_gb} GB budget)", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()
