@@ -169,6 +169,79 @@ def main() -> None:
         out = eng.generate(prompts(n_req, salt=2), sp)
         return eng, out, time.monotonic() - t0
 
+    def tune_attention() -> None:
+        """On-chip: time candidate Pallas attention block sizes at the decode
+        shape and export the winner via LLMD_ATTN_BKV/BQ. Kernel ablation
+        showed attention at 4.4 ms/step vs a ~0.9 ms KV-read roofline — the
+        single largest per-step cost — and the default (bkv=8, bq=32) was
+        chosen with broken timing (block_until_ready is a no-op through the
+        tunnel). Wholly best-effort: any failure keeps the defaults."""
+        if jax.default_backend() != "tpu":
+            return
+        import numpy as _np
+
+        from llmd_tpu.ops.paged_attention import VMEM_LIMIT, _kernel
+
+        B = eng_cfg.max_batch_size
+        ps = 16
+        kvlen = isl + osl // 2
+        maxp = (isl + osl + eng_cfg.decode_steps * 3) // ps + 1
+        npages = max(1024, B * maxp)
+        Hk = max(1, cfg.num_kv_heads)
+        Dhp = 128
+        cache = jnp.zeros((npages, ps, 2 * Hk, Dhp), jnp.bfloat16)
+        pts = _np.zeros((B, maxp), _np.int32)
+        for i in range(B):
+            pts[i] = (_np.arange(i * maxp, (i + 1) * maxp)) % npages
+        pts = jnp.asarray(pts)
+        kv_lens = jnp.full((B,), kvlen, jnp.int32)
+        cu = jnp.asarray(_np.arange(B + 1), jnp.int32)
+        ns = jnp.asarray([B], jnp.int32)
+        q0 = jnp.ones((B, cfg.num_heads, Dhp), jnp.bfloat16)
+        rpa = _kernel()
+
+        def timed(bkv: int, bq: int) -> float:
+            import jax.lax as lax
+
+            def f(q):
+                def body(qq, _):
+                    o = rpa(qq, cache, kv_lens, pts, cu, ns, sm_scale=0.125,
+                            num_kv_pages_per_block=bkv, num_queries_per_block=bq,
+                            vmem_limit_bytes=VMEM_LIMIT)
+                    return (o * 1e-3 + qq * 0.999).astype(qq.dtype), None
+                qq, _ = lax.scan(body, q, None, length=16)
+                return jnp.sum(qq.astype(jnp.float32))
+            jf = jax.jit(f)
+            _np.asarray(jax.device_get(jf(q0)))  # compile + settle
+            # FRESH input for the measured call: the tunneled runtime
+            # content-caches identical (executable, args) pairs — re-timing q0
+            # would measure the cache, not the kernel
+            t0 = time.monotonic()
+            _np.asarray(jax.device_get(jf(q0 * jnp.bfloat16(1.001))))
+            return time.monotonic() - t0
+
+        candidates = [(8, 32), (max(1, maxp // 2), 32), (maxp, 32), (8, 16)]
+        best, best_t = None, float("inf")
+        for bkv, bq in candidates:
+            try:
+                dt = timed(bkv, bq)
+            except Exception:
+                continue
+            print(f"# attn-tune bkv={bkv} bq={bq}: {dt*1e3:.1f} ms/16calls",
+                  file=sys.stderr)
+            if dt < best_t:
+                best, best_t = (bkv, bq), dt
+        if best is not None and best != (8, 32):
+            os.environ["LLMD_ATTN_BKV"] = str(best[0])
+            os.environ["LLMD_ATTN_BQ"] = str(best[1])
+            print(f"# attn-tune picked bkv={best[0]} bq={best[1]}", file=sys.stderr)
+
+    if not tiny:
+        try:
+            tune_attention()
+        except Exception as e:  # tuning must never cost the bench run
+            print(f"# attn-tune skipped ({type(e).__name__}: {e})", file=sys.stderr)
+
     primary_error = None
     try:
         eng, out, wall = build_and_measure(eng_cfg)

@@ -48,9 +48,21 @@ def pick_block_sizes(num_tokens: int, page_size: int, pages_per_seq: int) -> tup
     KV blocks sized ~128 tokens keep decode DMAs overlapped without predicating
     past short sequences (v5e sweep above); q blocks of 32 cover a full decode
     batch row budget per program, 64+ for big prefill batches.
+
+    ``LLMD_ATTN_BKV`` / ``LLMD_ATTN_BQ`` override the policy — bench.py's
+    on-chip auto-tuner sets them after timing candidates at the serving shape
+    (per-chip optima vary; see deploy/ENV_VARS.md).
     """
+    import os
+
+    env_bkv = os.environ.get("LLMD_ATTN_BKV")
+    env_bq = os.environ.get("LLMD_ATTN_BQ")
     bkv = max(1, min(pages_per_seq, max(1, 128 // page_size)))
     bq = 32 if num_tokens <= 512 else 64
+    if env_bkv:
+        bkv = max(1, min(pages_per_seq, int(env_bkv)))
+    if env_bq:
+        bq = max(1, int(env_bq))
     return bkv, min(bq, num_tokens)
 
 
