@@ -55,14 +55,26 @@ def pick_block_sizes(num_tokens: int, page_size: int, pages_per_seq: int) -> tup
     """
     import os
 
-    env_bkv = os.environ.get("LLMD_ATTN_BKV")
-    env_bq = os.environ.get("LLMD_ATTN_BQ")
     bkv = max(1, min(pages_per_seq, max(1, 128 // page_size)))
     bq = 32 if num_tokens <= 512 else 64
-    if env_bkv:
-        bkv = max(1, min(pages_per_seq, int(env_bkv)))
-    if env_bq:
-        bq = max(1, int(env_bq))
+    if num_tokens <= 512:
+        # overrides are tuned at the DECODE shape only; prefill (large token
+        # batches) keeps the swept policy
+        def _env_int(name: str):
+            raw = os.environ.get(name)
+            if not raw:
+                return None
+            try:
+                return int(raw)
+            except ValueError:
+                return None  # malformed operator input: keep the policy
+
+        env_bkv = _env_int("LLMD_ATTN_BKV")
+        env_bq = _env_int("LLMD_ATTN_BQ")
+        if env_bkv:
+            bkv = max(1, min(pages_per_seq, env_bkv))
+        if env_bq:
+            bq = max(1, env_bq)
     return bkv, min(bq, num_tokens)
 
 
