@@ -217,10 +217,12 @@ def main() -> None:
             _np.asarray(jax.device_get(jf(q0)))  # compile + settle
             # FRESH input per measured call: the tunneled runtime
             # content-caches identical (executable, args) pairs — re-timing q0
-            # would measure the cache, not the kernel. min-of-2 damps the
-            # per-dispatch RTT jitter that could crown a slower config.
+            # would measure the cache, not the kernel. Multipliers must be
+            # EXACTLY representable in bf16 (1.001 rounds to 1.0 — spacing near
+            # 1.0 is 1/128 — which would reproduce q0 bitwise and hit the
+            # cache). min-of-2 damps per-dispatch RTT jitter.
             times = []
-            for rep in (1.001, 1.002):
+            for rep in (1.0078125, 1.015625):  # 1+1/128, 1+2/128: exact in bf16
                 t0 = time.monotonic()
                 _np.asarray(jax.device_get(jf(q0 * jnp.bfloat16(rep))))
                 times.append(time.monotonic() - t0)

@@ -57,9 +57,11 @@ def pick_block_sizes(num_tokens: int, page_size: int, pages_per_seq: int) -> tup
 
     bkv = max(1, min(pages_per_seq, max(1, 128 // page_size)))
     bq = 32 if num_tokens <= 512 else 64
-    if num_tokens <= 512:
-        # overrides are tuned at the DECODE shape only; prefill (large token
-        # batches) keeps the swept policy
+    if num_tokens <= 128:
+        # overrides are tuned at the DECODE shape (one query per sequence,
+        # num_tokens == batch ≤ 128); bigger token batches — prefill chunks —
+        # keep the swept policy. The two regimes are only distinguishable here
+        # by size: serving prefill packs ≥256-token budgets.
         def _env_int(name: str):
             raw = os.environ.get(name)
             if not raw:

@@ -1,0 +1,152 @@
+"""Ring attention: context-parallel causal attention over the ``sp`` mesh axis.
+
+The long-context design the task calls first-class: a sequence too long for one
+chip's HBM shards across the ``sp`` axis; each device holds S/N query and KV
+tokens, and attention runs in N ring steps — compute the partial attention of
+local queries against the resident KV block, then ``ppermute`` the KV block to
+the next device, overlapping the collective with the next block's compute (XLA
+schedules the permute against the matmuls; ICI bandwidth hides behind MXU time
+at serving block sizes).
+
+Numerics: online softmax (flash-attention style running max/denominator), so
+the result is exact attention — not an approximation — regardless of ring
+order. Causality is resolved block-wise: a KV block strictly newer than every
+local query contributes nothing (its lanes are masked), the diagonal block gets
+the triangular mask, older blocks attend fully.
+
+This is the context-parallel ATTENTION OP for the sharded long-prefill path —
+self-contained and oracle-tested here; engine integration (routing sp-sharded
+prefill chunks through it instead of the GSPMD-gathered path) is the follow-up.
+The serving engine's paged decode keeps per-sequence KV local either way
+(decode reads are tiny — sp parallelism pays off in prefill, where the S² term
+lives). `sp_flash_prefill` below is the jittable entry: q/k/v arrive already
+sharded on the sequence axis under `shard_map`.
+
+Reference framing: the CUDA stacks reach for ring/context parallelism via NCCL
+P2P; here the ring is `jax.lax.ppermute` over ICI — the collective the "How to
+Scale Your Model" recipe prescribes for sequence parallelism.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask, m_prev, l_prev, acc_prev, scale):
+    """One KV block's contribution under online softmax.
+
+    q: [Sq, H, D]; k/v: [Sk, H, D]; mask: [Sq, Sk] (True = attend).
+    Carries m (running max, [Sq, H]), l (running denom), acc ([Sq, H, D]).
+    """
+    s = jnp.einsum("qhd,khd->qhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale  # [Sq, H, Sk]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))  # [Sq, H]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be exp(0)=1
+    alive = m_new > NEG_INF / 2
+    p = jnp.exp(jnp.where(alive[:, :, None], s - m_new[:, :, None], NEG_INF))
+    correction = jnp.exp(jnp.where(alive, m_prev - m_new, 0.0))
+    l_new = l_prev * correction + p.sum(axis=-1)
+    acc_new = acc_prev * correction[:, :, None] + jnp.einsum(
+        "qhk,khd->qhd", p, v.astype(jnp.float32))
+    return m_new, l_new, acc_new
+
+
+def ring_attention_sharded(q, k, v, *, axis_name: str, scale: float,
+                           shard_index: Optional[jax.Array] = None):
+    """Exact causal attention for sequence-sharded q/k/v inside ``shard_map``.
+
+    q, k, v: [S_local, H, D] — this device's contiguous slice of the sequence
+    (slice order = position order along the axis). Returns [S_local, H, D].
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name) if shard_index is None else shard_index
+    S, H, D = q.shape
+    pos_local = jnp.arange(S)
+
+    def step(carry, i):
+        kv, m, l, acc = carry
+        kb, vb = kv
+        src_shard = (my - i) % n  # whose block we hold at ring step i
+        # block-wise causality: queries at global q_pos attend keys at k_pos <= q_pos
+        q_pos = my * S + pos_local  # [S]
+        k_pos = src_shard * S + pos_local  # [S] (uniform shard size)
+        mask = k_pos[None, :] <= q_pos[:, None]
+        # strictly-future blocks (src_shard > my) are fully masked — skip their
+        # einsums entirely: causal ring does ~n²/2 useful block-attends, and
+        # paying all n² doubles the S² FLOPs this op exists to scale
+        m, l, acc = lax.cond(
+            src_shard <= my,
+            lambda args: _block_attn(*args, scale),
+            lambda args: (args[4], args[5], args[6]),
+            (q, kb, vb, mask, m, l, acc),
+        )
+        # rotate KV around the ring: device d hands its block to d+1. The final
+        # iteration's rotation would feed nothing — skip the collective (i is
+        # uniform across devices, so every device takes the same branch).
+        kv = lax.cond(
+            i < n - 1,
+            lambda t: jax.tree.map(
+                lambda x: lax.ppermute(
+                    x, axis_name, [(j, (j + 1) % n) for j in range(n)]), t),
+            lambda t: t,
+            (kb, vb),
+        )
+        return (kv, m, l, acc), None
+
+    # the zero-init carries are device-invariant but the loop outputs vary
+    # over the ring axis — shard_map's varying-axes check requires the carry
+    # types to agree up front (pcast on current jax; pvary on older)
+    def _mark_varying(x):
+        if hasattr(lax, "pcast"):
+            return lax.pcast(x, axis_name, to="varying")
+        return lax.pvary(x, axis_name)
+
+    m0 = _mark_varying(jnp.full((S, H), NEG_INF, jnp.float32))
+    l0 = _mark_varying(jnp.zeros((S, H), jnp.float32))
+    acc0 = _mark_varying(jnp.zeros((S, H, D), jnp.float32))
+    (kv, m, l, acc), _ = lax.scan(
+        step, ((k, v), m0, l0, acc0), jnp.arange(n, dtype=jnp.int32))
+    out = acc / jnp.maximum(l, 1e-30)[:, :, None]
+    return out.astype(q.dtype)
+
+
+def sp_flash_prefill(q, k, v, mesh, *, scale: Optional[float] = None,
+                     axis_name: str = "sp"):
+    """Jittable entry: full-sequence q/k/v [S, H, D] → causal attention [S, H, D],
+    computed ring-parallel over ``mesh``'s ``axis_name`` axis. S must divide
+    evenly by the axis size (pad upstream — the engine's chunking already works
+    in page multiples)."""
+    from jax.sharding import PartitionSpec as P
+
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(axis_name, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    def run(qs, ks, vs):
+        return ring_attention_sharded(qs, ks, vs, axis_name=axis_name,
+                                      scale=scale)
+
+    return run(q, k, v)
+
+
+def reference_causal_attention(q, k, v, scale: Optional[float] = None):
+    """Dense causal attention (the correctness oracle for the ring path)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    S = q.shape[0]
+    s = jnp.einsum("qhd,khd->qhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("qhk,khd->qhd", p, v.astype(jnp.float32)).astype(q.dtype)
