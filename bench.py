@@ -245,8 +245,12 @@ def main() -> None:
             if best != default and results[best] < 0.95 * results[default]:
                 os.environ["LLMD_ATTN_BKV"] = str(best[0])
                 os.environ["LLMD_ATTN_BQ"] = str(best[1])
-                print(f"# attn-tune picked bkv={best[0]} bq={best[1]}",
-                      file=sys.stderr)
+                # gate tracks the exact batch the candidates were timed at —
+                # without it a --batch 256 run would tune, export, and then
+                # silently never apply the overrides (default gate is 128)
+                os.environ["LLMD_ATTN_DECODE_N"] = str(B)
+                print(f"# attn-tune picked bkv={best[0]} bq={best[1]} "
+                      f"(decode_n={B})", file=sys.stderr)
 
     if not tiny:
         try:

@@ -57,11 +57,17 @@ def pick_block_sizes(num_tokens: int, page_size: int, pages_per_seq: int) -> tup
 
     bkv = max(1, min(pages_per_seq, max(1, 128 // page_size)))
     bq = 32 if num_tokens <= 512 else 64
-    if num_tokens <= 128:
+    try:
+        decode_n = int(os.environ.get("LLMD_ATTN_DECODE_N", "128"))
+    except ValueError:
+        decode_n = 128
+    if num_tokens <= decode_n:
         # overrides are tuned at the DECODE shape (one query per sequence,
-        # num_tokens == batch ≤ 128); bigger token batches — prefill chunks —
-        # keep the swept policy. The two regimes are only distinguishable here
-        # by size: serving prefill packs ≥256-token budgets.
+        # num_tokens == batch); the tuner exports that batch size as
+        # LLMD_ATTN_DECODE_N so the gate tracks the shape it validated.
+        # Token batches above it — prefill budgets — keep the swept policy
+        # (short tail chunks below the gate share the decode policy; a
+        # perf-only approximation on the rare last chunk of a prompt).
         def _env_int(name: str):
             raw = os.environ.get(name)
             if not raw:

@@ -25,6 +25,14 @@ sharded on the sequence axis under `shard_map`.
 Reference framing: the CUDA stacks reach for ring/context parallelism via NCCL
 P2P; here the ring is `jax.lax.ppermute` over ICI — the collective the "How to
 Scale Your Model" recipe prescribes for sequence parallelism.
+
+Known follow-up: contiguous sharding leaves the causal ring load-imbalanced
+(the last shard computes at every ring step while shard 0 computes once — the
+skip only saves energy, not wall-clock, since ppermute synchronizes each
+step). The standard fix is zig-zag partitioning: each device holds one chunk
+from each END of the sequence, so every device does ~equal causal work per
+step. That changes the slice-order contract with the caller; land it together
+with the engine integration.
 """
 
 from __future__ import annotations
