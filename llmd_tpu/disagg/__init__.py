@@ -14,6 +14,7 @@ from llmd_tpu.disagg.transfer import (
     insert_blocks,
 )
 from llmd_tpu.disagg.sidecar import RoutingSidecar
+from llmd_tpu.disagg.encode import EncodeServer, VisionRunner  # noqa: F401
 
 __all__ = [
     "KVTransferClient",

@@ -12,6 +12,15 @@ Re-implements the reference's KV plane (docs/architecture/advanced/kv-management
   (kv-offloader.md:27-118; TPUOffloadConnector analogue).
 - ``llmd_tpu.kv.fs_backend`` — POSIX-FS KV block store (llmd_fs_backend analogue,
   kv-offloader.md:120-169).
+- ``llmd_tpu.kv.connector_api`` — out-of-tree connector seam (LMCache/Mooncake/KVBM
+  role, kv-offloader.md:70-100) with the in-memory reference connector.
+- ``llmd_tpu.kv.remote_store`` — remote content-addressed block store over TCP
+  (the InfiniStore role) + its engine-side connector.
 """
 
+from llmd_tpu.kv.connector_api import (  # noqa: F401
+    KVConnectorBase,
+    build_kv_connector,
+    register_kv_connector,
+)
 from llmd_tpu.kv.indexer import KVBlockIndex  # noqa: F401

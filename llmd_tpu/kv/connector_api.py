@@ -67,7 +67,16 @@ def register_kv_connector(name: str,
     _REGISTRY[name] = factory
 
 
+# in-tree connectors register at module import; map names to their modules so
+# an EngineConfig naming one works without the caller importing it first
+_BUILTIN_MODULES = {"remote-store": "llmd_tpu.kv.remote_store"}
+
+
 def build_kv_connector(name: str, params: Optional[dict] = None) -> KVConnectorBase:
+    if name not in _REGISTRY and name in _BUILTIN_MODULES:
+        import importlib
+
+        importlib.import_module(_BUILTIN_MODULES[name])
     if name not in _REGISTRY:
         raise KeyError(f"unknown KV connector {name!r}; registered: {sorted(_REGISTRY)}")
     return _REGISTRY[name](params)

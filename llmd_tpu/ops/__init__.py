@@ -1,6 +1,7 @@
-"""Pallas TPU kernels for the hot ops (the reference's FlashInfer/DeepGEMM slot,
-SURVEY.md §2.5 N7-N8)."""
+"""Pallas TPU kernels + collective attention for the hot ops (the reference's
+FlashInfer/DeepGEMM slot, SURVEY.md §2.5 N7-N8; ring attention for sp)."""
 
 from llmd_tpu.ops.paged_attention import paged_attention_tpu
+from llmd_tpu.ops.ring_attention import ring_attention_sharded, sp_flash_prefill
 
-__all__ = ["paged_attention_tpu"]
+__all__ = ["paged_attention_tpu", "ring_attention_sharded", "sp_flash_prefill"]
