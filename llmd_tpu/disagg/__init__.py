@@ -17,10 +17,12 @@ from llmd_tpu.disagg.sidecar import RoutingSidecar
 from llmd_tpu.disagg.encode import EncodeServer, VisionRunner  # noqa: F401
 
 __all__ = [
+    "EncodeServer",
     "KVTransferClient",
     "KVTransferParams",
     "KVTransferSource",
     "RoutingSidecar",
+    "VisionRunner",
     "extract_blocks",
     "insert_blocks",
 ]

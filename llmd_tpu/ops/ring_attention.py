@@ -26,13 +26,13 @@ Reference framing: the CUDA stacks reach for ring/context parallelism via NCCL
 P2P; here the ring is `jax.lax.ppermute` over ICI — the collective the "How to
 Scale Your Model" recipe prescribes for sequence parallelism.
 
-Known follow-up: contiguous sharding leaves the causal ring load-imbalanced
-(the last shard computes at every ring step while shard 0 computes once — the
-skip only saves energy, not wall-clock, since ppermute synchronizes each
-step). The standard fix is zig-zag partitioning: each device holds one chunk
-from each END of the sequence, so every device does ~equal causal work per
-step. That changes the slice-order contract with the caller; land it together
-with the engine integration.
+Load balance: contiguous sharding leaves the causal ring imbalanced (the last
+shard computes at every ring step while shard 0 computes once, and ppermute
+synchronizes each step). ``sp_flash_prefill`` therefore defaults to ZIG-ZAG
+partitioning — each device holds one chunk from each END of the sequence, so
+causal work is ~equal per device per step — with the natural↔zig-zag
+permutation handled inside the entry point (identical results either way,
+oracle-tested).
 """
 
 from __future__ import annotations
@@ -67,35 +67,79 @@ def _block_attn(q, k, v, mask, m_prev, l_prev, acc_prev, scale):
     return m_new, l_new, acc_new
 
 
+def _guarded_attn(pred, q, k, v, mask, m, l, acc, scale):
+    """Run the block attend only when ``pred`` (traced bool) says it can
+    contribute; identity carry otherwise — masked-out blocks never touch the
+    MXU."""
+    return lax.cond(
+        pred,
+        lambda args: _block_attn(*args, scale),
+        lambda args: (args[4], args[5], args[6]),
+        (q, k, v, mask, m, l, acc),
+    )
+
+
 def ring_attention_sharded(q, k, v, *, axis_name: str, scale: float,
-                           shard_index: Optional[jax.Array] = None):
+                           shard_index: Optional[jax.Array] = None,
+                           zigzag: bool = False):
     """Exact causal attention for sequence-sharded q/k/v inside ``shard_map``.
 
-    q, k, v: [S_local, H, D] — this device's contiguous slice of the sequence
-    (slice order = position order along the axis). Returns [S_local, H, D].
+    q, k, v: [S_local, H, D] — this device's slice of the sequence. Contiguous
+    layout: shard s holds positions s*S_local... Zig-zag layout
+    (``zigzag=True``): shard s holds chunk s then chunk 2n-1-s (each C =
+    S_local/2 rows) — the balanced schedule where every device runs exactly
+    two C×C sub-attends per ring step (lo-key→hi-query always; plus lo→lo when
+    src≤my or hi→hi when src≥my), instead of the contiguous ring's worst shard
+    paying the full block at every step. Returns [S_local, H, D].
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name) if shard_index is None else shard_index
     S, H, D = q.shape
-    pos_local = jnp.arange(S)
 
-    def step(carry, i):
+    def step_contiguous(carry, i):
         kv, m, l, acc = carry
         kb, vb = kv
-        src_shard = (my - i) % n  # whose block we hold at ring step i
-        # block-wise causality: queries at global q_pos attend keys at k_pos <= q_pos
-        q_pos = my * S + pos_local  # [S]
-        k_pos = src_shard * S + pos_local  # [S] (uniform shard size)
+        src = (my - i) % n  # whose block we hold at ring step i
+        # causality by GLOBAL position: queries attend keys at k_pos <= q_pos
+        q_pos = my * S + jnp.arange(S)
+        k_pos = src * S + jnp.arange(S)
         mask = k_pos[None, :] <= q_pos[:, None]
-        # strictly-future blocks (src_shard > my) are fully masked — skip their
-        # einsums entirely: causal ring does ~n²/2 useful block-attends, and
-        # paying all n² doubles the S² FLOPs this op exists to scale
-        m, l, acc = lax.cond(
-            src_shard <= my,
-            lambda args: _block_attn(*args, scale),
-            lambda args: (args[4], args[5], args[6]),
-            (q, kb, vb, mask, m, l, acc),
-        )
+        # strictly-future blocks skip the einsums entirely: causal ring does
+        # ~n²/2 useful block-attends and the rest must stay off the MXU
+        m, l, acc = _guarded_attn(src <= my, q, kb, vb, mask, m, l, acc, scale)
+        return _rotate(kv, kb, vb, m, l, acc, i)
+
+    def step_zigzag(carry, i):
+        kv, m, l, acc = carry
+        kb, vb = kv
+        src = (my - i) % n
+        C = S // 2
+        ar = jnp.arange(C)
+        q_lo_pos, q_hi_pos = my * C + ar, (2 * n - 1 - my) * C + ar
+        k_lo_pos, k_hi_pos = src * C + ar, (2 * n - 1 - src) * C + ar
+        (q_lo, q_hi), (k_lo, k_hi), (v_lo, v_hi) = (
+            (t[:C], t[C:]) for t in (q, kb, vb))
+        m_lo, m_hi = m[:C], m[C:]
+        l_lo, l_hi = l[:C], l[C:]
+        a_lo, a_hi = acc[:C], acc[C:]
+        # (k_lo → q_lo): same-or-older low chunk; triangular iff src == my
+        m_lo, l_lo, a_lo = _guarded_attn(
+            src <= my, q_lo, k_lo, v_lo,
+            k_lo_pos[None, :] <= q_lo_pos[:, None], m_lo, l_lo, a_lo, scale)
+        # (k_lo → q_hi): every low chunk precedes every high chunk — always on
+        m_hi, l_hi, a_hi = _block_attn(
+            q_hi, k_lo, v_lo, k_lo_pos[None, :] <= q_hi_pos[:, None],
+            m_hi, l_hi, a_hi, scale)
+        # (k_hi → q_hi): high chunks order REVERSES with shard id
+        m_hi, l_hi, a_hi = _guarded_attn(
+            src >= my, q_hi, k_hi, v_hi,
+            k_hi_pos[None, :] <= q_hi_pos[:, None], m_hi, l_hi, a_hi, scale)
+        # (k_hi → q_lo): strictly future for every pair — never computed
+        m = jnp.concatenate([m_lo, m_hi])
+        l = jnp.concatenate([l_lo, l_hi])
+        acc = jnp.concatenate([a_lo, a_hi])
+        return _rotate(kv, kb, vb, m, l, acc, i)
+    def _rotate(kv, kb, vb, m, l, acc, i):
         # rotate KV around the ring: device d hands its block to d+1. The final
         # iteration's rotation would feed nothing — skip the collective (i is
         # uniform across devices, so every device takes the same branch).
@@ -108,6 +152,8 @@ def ring_attention_sharded(q, k, v, *, axis_name: str, scale: float,
             (kb, vb),
         )
         return (kv, m, l, acc), None
+
+    step = step_zigzag if zigzag else step_contiguous
 
     # the zero-init carries are device-invariant but the loop outputs vary
     # over the ring axis — shard_map's varying-axes check requires the carry
@@ -127,24 +173,57 @@ def ring_attention_sharded(q, k, v, *, axis_name: str, scale: float,
 
 
 def sp_flash_prefill(q, k, v, mesh, *, scale: Optional[float] = None,
-                     axis_name: str = "sp"):
+                     axis_name: str = "sp", zigzag: bool = True):
     """Jittable entry: full-sequence q/k/v [S, H, D] → causal attention [S, H, D],
     computed ring-parallel over ``mesh``'s ``axis_name`` axis. S must divide
-    evenly by the axis size (pad upstream — the engine's chunking already works
-    in page multiples)."""
+    evenly by 2× the axis size (pad upstream — the engine's chunking already
+    works in page multiples).
+
+    ``zigzag=True`` (default) assigns each device one chunk from EACH END of
+    the sequence (device d holds chunks d and 2n-1-d), so causal work is
+    ~equal per device per ring step — the contiguous layout leaves the last
+    shard computing at every step while shard 0 idles behind the ppermute
+    barrier, ~2× the wall clock for identical results."""
     from jax.sharding import PartitionSpec as P
 
     if scale is None:
         scale = q.shape[-1] ** -0.5
     spec = P(axis_name, None, None)
+    n = mesh.shape[axis_name]
+    S = q.shape[0]
+
+    use_zigzag = zigzag and n > 1 and S % (2 * n) == 0
+    if zigzag and n > 1 and not use_zigzag:
+        # zig-zag needs S divisible by 2n; contiguous only needs n. Degrade
+        # loudly-enough (perf property, not correctness) rather than truncate.
+        import warnings
+
+        warnings.warn(f"ring attention: S={S} not divisible by 2*{n}; "
+                      "using the contiguous (imbalanced) layout")
+    if S % n != 0:
+        raise ValueError(f"sequence length {S} must divide by the {axis_name} "
+                         f"axis size {n} (pad upstream)")
+    if use_zigzag:
+        C = S // (2 * n)
+        # device d's rows: chunk d then chunk 2n-1-d (natural→zigzag gather is
+        # a GSPMD permute at prefill scale — negligible next to the S² attends)
+        chunk_ids = jnp.stack(
+            [jnp.arange(n), 2 * n - 1 - jnp.arange(n)], axis=1).reshape(-1)
+        perm = (chunk_ids[:, None] * C + jnp.arange(C)[None, :]).reshape(-1)
+        inv = jnp.argsort(perm)
+    else:
+        perm = inv = None
 
     @functools.partial(
         jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     def run(qs, ks, vs):
         return ring_attention_sharded(qs, ks, vs, axis_name=axis_name,
-                                      scale=scale)
+                                      scale=scale, zigzag=use_zigzag)
 
-    return run(q, k, v)
+    if perm is None:
+        return run(q, k, v)
+    out = run(q[perm], k[perm], v[perm])
+    return out[inv]
 
 
 def reference_causal_attention(q, k, v, scale: Optional[float] = None):

@@ -24,13 +24,22 @@ def _qkv(S, H=4, D=32, seed=0):
 
 
 @pytest.mark.parametrize("n_shards", [2, 4, 8])
-def test_ring_matches_dense_causal(n_shards):
+@pytest.mark.parametrize("zigzag", [False, True])
+def test_ring_matches_dense_causal(n_shards, zigzag):
     S = 16 * n_shards
     q, k, v = _qkv(S, seed=n_shards)
     want = reference_causal_attention(q, k, v)
-    got = sp_flash_prefill(q, k, v, _mesh(n_shards))
+    got = sp_flash_prefill(q, k, v, _mesh(n_shards), zigzag=zigzag)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_zigzag_equals_contiguous():
+    """Both layouts compute EXACT attention — identical up to fp reassociation."""
+    q, k, v = _qkv(64, seed=11)
+    a = sp_flash_prefill(q, k, v, _mesh(4), zigzag=False)
+    b = sp_flash_prefill(q, k, v, _mesh(4), zigzag=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5)
 
 
 def test_ring_single_shard_degenerates_to_dense():
