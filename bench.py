@@ -42,6 +42,43 @@ def _param_count(cfg) -> int:
     return per_layer * L + emb
 
 
+def _device_preflight(attempts: int = 3, wait_s: float = 30.0,
+                      timeout_s: float = 180.0) -> str | None:
+    """Probe TPU backend init in a SUBPROCESS, with bounded retries + backoff.
+
+    r04 lost its only hardware number to a transient backend-init UNAVAILABLE
+    (rc=1 before any engine code ran), and ``jax.devices()`` has been observed
+    to hang >120 s when the fabric is down — so the probe runs out-of-process
+    (a hang or failure cannot poison this process's cached backend state) under
+    a hard timeout. Returns None once a device answers, else the last error
+    string so the caller can emit a structured device-unavailable JSON with
+    rc=0 instead of dying.
+    """
+    import subprocess
+    last = "unknown"
+    for i in range(attempts):
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
+                capture_output=True, text=True, timeout=timeout_s)
+            if p.returncode == 0:
+                if i:
+                    print(f"# device preflight recovered on attempt {i + 1}",
+                          file=sys.stderr)
+                return None
+            lines = (p.stderr or p.stdout).strip().splitlines()
+            last = lines[-1][:500] if lines else f"rc={p.returncode}"
+        except subprocess.TimeoutExpired:
+            last = f"backend init timed out after {timeout_s:.0f}s"
+        print(f"# device preflight attempt {i + 1}/{attempts} failed: {last}",
+              file=sys.stderr)
+        if i + 1 < attempts:
+            print(f"# retrying in {wait_s:.0f}s", file=sys.stderr)
+            time.sleep(wait_s)
+    return last
+
+
 def _chip_peaks(device_kind: str) -> tuple[float, float]:
     """(bf16 TFLOP/s, HBM GB/s) for MFU / bandwidth-utilization estimates."""
     kinds = {
@@ -77,6 +114,17 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        err = _device_preflight()
+        if err is not None:
+            # rc=0 + structured skip: a flaky fabric must never erase a
+            # round's number as an opaque crash (VERDICT r4 weak #1)
+            print(json.dumps({
+                "metric": "output_tok_per_s_per_chip", "value": None,
+                "unit": "tok/s", "vs_baseline": None,
+                "skipped": "device-unavailable", "error": err,
+            }))
+            return
     import jax
 
     from llmd_tpu.core.request import SamplingParams

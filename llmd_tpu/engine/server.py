@@ -123,6 +123,10 @@ class EngineServer:
 
         self._conversations: "OrderedDict[str, dict]" = OrderedDict()
         self._max_conversations = 4096
+        # per-conversation growth is ALSO capped: one long-lived conversation
+        # appending forever must not grow pod memory unboundedly — past the
+        # cap the oldest items roll off (context-window semantics)
+        self._max_conv_items = 512
         from llmd_tpu.obs.tracing import global_tracer
 
         self.tracer = global_tracer()  # engine hop joins the EPP trace
@@ -638,9 +642,14 @@ class EngineServer:
         if conv is not None:
             conv["items"].extend(new_msgs)
             conv["items"].append({"role": "assistant", "content": text})
+            self._conv_trim(conv)
         if conv_id:
             resp["conversation"] = conv_id
         return web.json_response(resp)
+
+    def _conv_trim(self, conv: dict) -> None:
+        if len(conv["items"]) > self._max_conv_items:
+            del conv["items"][: len(conv["items"]) - self._max_conv_items]
 
     async def _conv_create(self, request: web.Request):
         try:
@@ -653,6 +662,7 @@ class EngineServer:
         conv = {"id": cid, "object": "conversation", "created_at": int(time.time()),
                 "items": list(body.get("items", []) or []),
                 "metadata": body.get("metadata") or {}}
+        self._conv_trim(conv)
         self._conversations[cid] = conv
         while len(self._conversations) > self._max_conversations:
             self._conversations.popitem(last=False)
@@ -687,6 +697,7 @@ class EngineServer:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
         items = body.get("items", [])
         conv["items"].extend(items)
+        self._conv_trim(conv)
         return web.json_response({"object": "list", "data": items})
 
     async def _conv_list_items(self, request: web.Request):

@@ -83,11 +83,35 @@ class RemoteKVStoreServer:
     def stop(self) -> None:
         self._stop.set()
         if self._srv is not None:
+            # close() alone does NOT interrupt a thread blocked in accept()
+            # on Linux — the syscall pins the kernel socket, which keeps
+            # accepting (and serving) connections until accept returns. A
+            # self-connection wakes it so the loop observes _stop and exits.
+            wake_host = ("127.0.0.1" if self.host in ("0.0.0.0", "::")
+                         else self.host)
+            try:
+                with socket.create_connection((wake_host, self.port),
+                                              timeout=0.2):
+                    pass
+            except OSError:
+                pass
             self._srv.close()
 
     # -- storage -----------------------------------------------------------
     def _put(self, hashes: list[int], dtype: str, shape: tuple,
              payload: bytes) -> int:
+        # a truncated/misaligned client frame must not be stored under content
+        # hashes that later read back as valid KV bytes: nbytes must be exactly
+        # n blocks of the declared dtype/shape
+        try:
+            expect = (len(hashes) * int(np.prod(shape or (1,)))
+                      * np.dtype(dtype).itemsize)
+        except (TypeError, ValueError) as e:  # np.dtype('bogus') is a TypeError
+            raise ValueError(f"bad put header dtype/shape: {e}") from e
+        if len(payload) != expect:
+            raise ValueError(
+                f"put payload {len(payload)}B != {len(hashes)} blocks of "
+                f"{dtype}{tuple(shape)} = {expect}B")
         per = len(payload) // max(1, len(hashes))
         with self._lock:
             for i, h in enumerate(hashes):
@@ -116,6 +140,27 @@ class RemoteKVStoreServer:
                 out.append(h)
         return out
 
+    def _get(self, hashes: list[int]) -> tuple[list[int],
+                                               list[tuple[bytes, str, tuple]]]:
+        """Consecutive prefix AND its blobs under ONE critical section.
+
+        Scanning the prefix and fetching the blobs under separate lock
+        acquisitions is a poison race: a concurrent put-triggered eviction can
+        remove a middle block between the two, and the client would commit a
+        non-consecutive payload positionally under the consecutive hash chain.
+        """
+        have: list[int] = []
+        blobs: list[tuple[bytes, str, tuple]] = []
+        with self._lock:
+            for h in hashes:
+                entry = self._blocks.get(h)
+                if entry is None:
+                    break
+                self._blocks.move_to_end(h)
+                have.append(h)
+                blobs.append(entry)
+        return have, blobs
+
     # -- server loop -------------------------------------------------------
     def _accept_loop(self) -> None:
         while not self._stop.is_set():
@@ -133,28 +178,32 @@ class RemoteKVStoreServer:
                 op = hdr.get("op")
                 if op == "put":
                     payload = _recv_exact(conn, int(hdr["nbytes"]))
-                    n = self._put([int(h) for h in hdr["hashes"]],
-                                  hdr["dtype"], hdr["shape"], payload)
-                    _send_frame(conn, {"stored": n})
-                elif op in ("get", "probe"):
+                    try:
+                        n = self._put([int(h) for h in hdr["hashes"]],
+                                      hdr["dtype"], hdr["shape"], payload)
+                    except ValueError as e:
+                        _send_frame(conn, {"error": str(e), "stored": 0})
+                    else:
+                        _send_frame(conn, {"stored": n})
+                elif op == "probe":
                     hashes = [int(h) for h in hdr["hashes"]]
-                    have = self._prefix(hashes, touch=(op == "get"))
+                    have = self._prefix(hashes, touch=False)
                     self.stats["hit_blocks"] += len(have)
                     self.stats["miss_blocks"] += len(hashes) - len(have)
-                    if op == "probe":
-                        self.stats["probes"] += 1
-                        _send_frame(conn, {"found": len(have)})
-                    else:
-                        self.stats["gets"] += 1
-                        with self._lock:
-                            blobs = [self._blocks[h] for h in have
-                                     if h in self._blocks]
-                        payload = b"".join(b for b, _d, _s in blobs)
-                        meta = blobs[0] if blobs else (b"", "float32", ())
-                        _send_frame(conn, {"found": len(blobs),
-                                           "dtype": meta[1],
-                                           "shape": list(meta[2]),
-                                           "nbytes": len(payload)}, payload)
+                    self.stats["probes"] += 1
+                    _send_frame(conn, {"found": len(have)})
+                elif op == "get":
+                    hashes = [int(h) for h in hdr["hashes"]]
+                    have, blobs = self._get(hashes)
+                    self.stats["hit_blocks"] += len(have)
+                    self.stats["miss_blocks"] += len(hashes) - len(have)
+                    self.stats["gets"] += 1
+                    payload = b"".join(b for b, _d, _s in blobs)
+                    meta = blobs[0] if blobs else (b"", "float32", ())
+                    _send_frame(conn, {"found": len(blobs),
+                                       "dtype": meta[1],
+                                       "shape": list(meta[2]),
+                                       "nbytes": len(payload)}, payload)
                 elif op == "stats":
                     with self._lock:
                         _send_frame(conn, {**self.stats,
@@ -176,31 +225,84 @@ class RemoteKVConnector(KVConnectorBase):
         self.host = p.get("host", "127.0.0.1")
         self.port = int(p.get("port", 0))
         self.timeout = float(p.get("timeout_s", 5.0))
-        self.stats = {"errors": 0}
+        # get_num_matched_blocks runs under the engine scheduling lock — the
+        # connector API's own contract says 'must be cheap (index lookup, no
+        # IO)', so the admission probe gets a far tighter deadline than the
+        # bulk get/put paths: a blackholed store must not stall the step loop
+        self.probe_timeout = float(p.get("probe_timeout_s", 0.25))
+        # circuit breakers: after `breaker_errors` CONSECUTIVE failures the
+        # path goes dark for `breaker_cooldown_s` rather than paying a timeout
+        # per call forever. TWO independent breakers: the admission probe's
+        # tight deadline must not conflate a slow-but-healthy store (probe
+        # times out at 0.25s, bulk get/put fine within 5s) with a dead one —
+        # probe failures only stop probing; bulk failures stop everything.
+        self.breaker_errors = int(p.get("breaker_errors", 3))
+        self.breaker_cooldown = float(p.get("breaker_cooldown_s", 30.0))
+        self._consec_errors = {"probe": 0, "bulk": 0}
+        self._open_until = {"probe": 0.0, "bulk": 0.0}
+        self.stats = {"errors": 0, "breaker_trips": 0, "breaker_skips": 0}
 
-    def _rpc(self, header: dict, payload: bytes = b"") -> tuple[dict, bytes]:
+    def _rpc(self, header: dict, payload: bytes = b"",
+             timeout: Optional[float] = None) -> tuple[dict, bytes]:
         with socket.create_connection((self.host, self.port),
-                                      timeout=self.timeout) as conn:
+                                      timeout=timeout or self.timeout) as conn:
             _send_frame(conn, header, payload)
             resp, _ = _recv_frame(conn)
             body = _recv_exact(conn, int(resp["nbytes"])) if resp.get("nbytes") else b""
             return resp, body
 
+    def _breaker_open(self, path: str) -> bool:
+        import time as _time
+
+        now = _time.monotonic()
+        # a bulk-path outage silences the probe too (probing a dead store from
+        # under the engine lock is the stall the breaker exists to prevent)
+        for key in ({"probe", "bulk"} if path == "probe" else {path}):
+            if (self._consec_errors[key] >= self.breaker_errors
+                    and now < self._open_until[key]):
+                self.stats["breaker_skips"] += 1
+                return True
+        return False
+
+    def _record(self, ok: bool, path: str = "bulk") -> None:
+        import time as _time
+
+        if ok:
+            self._consec_errors[path] = 0
+            if path == "bulk":
+                # bulk success proves the store alive: give the probe its
+                # trial back immediately instead of waiting out the cooldown
+                self._open_until["probe"] = 0.0
+            return
+        self.stats["errors"] += 1
+        self._consec_errors[path] += 1
+        if self._consec_errors[path] == self.breaker_errors:
+            self.stats["breaker_trips"] += 1
+        if self._consec_errors[path] >= self.breaker_errors:
+            self._open_until[path] = _time.monotonic() + self.breaker_cooldown
+
     def get_num_matched_blocks(self, block_hashes: list[int]) -> int:
+        if self._breaker_open("probe"):
+            return 0
         try:
-            resp, _ = self._rpc({"op": "probe", "hashes": block_hashes})
+            resp, _ = self._rpc({"op": "probe", "hashes": block_hashes},
+                                timeout=self.probe_timeout)
+            self._record(ok=True, path="probe")
             return int(resp.get("found", 0))
         except (OSError, ConnectionError, KeyError, ValueError):
-            self.stats["errors"] += 1
-            return 0  # store down = no external hits; serving continues
+            self._record(ok=False, path="probe")
+            return 0  # store down/slow = no external hits; serving continues
 
     def load_blocks(self, cache, block_hashes, page_ids, pages_per_layer):
         from llmd_tpu.disagg.transfer import insert_blocks
 
         want = block_hashes[: len(page_ids)]
+        if self._breaker_open("bulk"):
+            return cache, 0
         try:
             resp, body = self._rpc({"op": "get", "hashes": want})
             n = int(resp.get("found", 0))
+            self._record(ok=True)
             if n == 0:
                 return cache, 0
             blocks = np.frombuffer(body, dtype=resp["dtype"]).reshape(
@@ -208,17 +310,20 @@ class RemoteKVConnector(KVConnectorBase):
             cache = insert_blocks(cache, page_ids[:n], blocks, pages_per_layer)
             return cache, n
         except (OSError, ConnectionError, KeyError, ValueError):
-            self.stats["errors"] += 1
+            self._record(ok=False)
             return cache, 0
 
     def save_blocks(self, block_hashes, token_chunks, blocks) -> None:
+        if self._breaker_open("bulk"):
+            return
         arr = np.ascontiguousarray(blocks)
         try:
             self._rpc({"op": "put", "hashes": list(block_hashes),
                        "dtype": str(arr.dtype), "shape": list(arr.shape[1:]),
                        "nbytes": arr.nbytes}, arr.tobytes())
+            self._record(ok=True)
         except (OSError, ConnectionError):
-            self.stats["errors"] += 1  # best-effort tier
+            self._record(ok=False)  # best-effort tier
 
 
 register_kv_connector("remote-store", RemoteKVConnector)

@@ -306,7 +306,13 @@ class RouterServer:
         conversation on any scale event)."""
         import hashlib as _h
 
-        eps = self.pool.list()
+        from llmd_tpu.core.endpoint import EndpointRole
+
+        # decode-capable pods only: Conversations/Responses state and the
+        # decode path don't exist on a prefill-only pod, so pinning a
+        # conversation there (which the scheduler's own filters would have
+        # excluded) would 404 every follow-up turn
+        eps = [e for e in self.pool.list() if e.role != EndpointRole.PREFILL]
         if not eps:
             return None
         cid = conversation_id.encode()

@@ -194,6 +194,60 @@ def test_dp_worker_report_raises_on_outage():
         w.report(True)
 
 
+# ------------------------------------------------- r4: sticky routing roles
+
+
+def test_sticky_endpoint_skips_prefill_only_pods():
+    """Conversation rendezvous hashing must only consider decode-capable pods:
+    a prefill-only pod has no Conversations state and no decode path (was:
+    hashed over pool.list() unfiltered)."""
+    from llmd_tpu.core.endpoint import Endpoint, EndpointRole
+    from llmd_tpu.router.datalayer import EndpointPool
+    from llmd_tpu.router.server import RouterServer
+    from llmd_tpu.core.config import FrameworkConfig
+    from llmd_tpu.router.plugins import known_plugin_types
+
+    pool = EndpointPool()
+    pool.upsert(Endpoint(address="p1:8000", role=EndpointRole.PREFILL))
+    pool.upsert(Endpoint(address="p2:8000", role=EndpointRole.PREFILL))
+    pool.upsert(Endpoint(address="d1:8000", role=EndpointRole.DECODE))
+    cfg = FrameworkConfig.from_yaml(
+        """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+""", known_types=known_plugin_types())
+    srv = RouterServer(cfg, pool, port=0)
+    # over many conversation ids, NO pick may land on a prefill pod
+    for i in range(64):
+        ep = srv._sticky_endpoint(f"conv_{i}")
+        assert ep.address == "d1:8000"
+    pool.upsert(Endpoint(address="d2:8000", role=EndpointRole.BOTH))
+    picks = {srv._sticky_endpoint(f"conv_{i}").address for i in range(64)}
+    assert picks <= {"d1:8000", "d2:8000"} and len(picks) == 2
+
+
+# ------------------------------------------- r4: conversation growth bounded
+
+
+def test_conversation_item_growth_is_capped():
+    """One long-lived conversation must not grow pod memory without bound:
+    past the per-conversation cap the oldest items roll off."""
+    from llmd_tpu.engine.server import EngineServer
+
+    srv = EngineServer.__new__(EngineServer)  # _conv_trim needs no engine
+    srv._max_conv_items = 512
+    conv = {"items": [{"n": i} for i in range(600)]}
+    srv._conv_trim(conv)
+    assert len(conv["items"]) == 512
+    assert conv["items"][0] == {"n": 88} and conv["items"][-1] == {"n": 599}
+    srv._conv_trim(conv)  # idempotent at the cap
+    assert len(conv["items"]) == 512
+
+
 def test_dp_worker_report_raises_on_error_response():
     """A coordinator ERROR reply (no 'step' key: corrupted line, version skew)
     must raise like an outage — not KeyError past the solo-mode handling and

@@ -83,3 +83,99 @@ def test_store_down_never_fails_serving():
     out = _run(eng, "a", list(range(50, 80)))
     assert len(out) == 4
     assert eng.kv_connector.stats["errors"] > 0  # failures visible, not fatal
+
+
+def test_put_rejects_misaligned_payload():
+    """A truncated client frame must not be stored under valid content hashes."""
+    srv = RemoteKVStoreServer()
+    srv.start()
+    try:
+        import socket as _s
+
+        from llmd_tpu.kv.remote_store import _recv_frame, _send_frame
+
+        with _s.create_connection((srv.host, srv.port), timeout=2) as c:
+            # claims 3 blocks of float32 (2,) = 24B but ships 20B
+            _send_frame(c, {"op": "put", "hashes": [1, 2, 3],
+                            "dtype": "float32", "shape": [2], "nbytes": 20},
+                        b"\x00" * 20)
+            resp, _ = _recv_frame(c)
+        assert resp["stored"] == 0 and "error" in resp
+        conn = RemoteKVConnector({"host": srv.host, "port": srv.port})
+        assert conn.get_num_matched_blocks([1, 2, 3]) == 0  # nothing poisoned
+    finally:
+        srv.stop()
+
+
+def test_get_prefix_and_blobs_atomic():
+    """The get path serves prefix + blobs from ONE critical section — a
+    concurrent eviction can shorten the prefix but never punch a hole in it."""
+    srv = RemoteKVStoreServer()
+    srv.start()
+    try:
+        conn = RemoteKVConnector({"host": srv.host, "port": srv.port})
+        blocks = np.arange(3 * 2 * 2, dtype=np.float32).reshape(3, 2, 2)
+        conn.save_blocks([7, 8, 9], [[1], [2], [3]], blocks)
+        # evict the MIDDLE block directly, then get: the consecutive contract
+        # means only [7] may be served, never [7, 9] positionally
+        with srv._lock:
+            blob, _d, _sh = srv._blocks.pop(8)
+            srv._bytes -= len(blob)
+        resp, body = conn._rpc({"op": "get", "hashes": [7, 8, 9]})
+        assert resp["found"] == 1
+        got = np.frombuffer(body, np.float32).reshape(1, 2, 2)
+        np.testing.assert_array_equal(got[0], blocks[0])
+    finally:
+        srv.stop()
+
+
+def test_probe_breaker_trips_and_recovers():
+    """Dead store: after breaker_errors consecutive failures the connector
+    answers instantly (no per-admission timeout), then retries after cooldown."""
+    import time as _t
+
+    srv = RemoteKVStoreServer()
+    srv.start()
+    conn = RemoteKVConnector({"host": srv.host, "port": srv.port,
+                              "probe_timeout_s": 0.2, "breaker_errors": 2,
+                              "breaker_cooldown_s": 30.0})
+    blocks = np.ones((1, 2, 2), np.float32)
+    conn.save_blocks([5], [[1]], blocks)
+    assert conn.get_num_matched_blocks([5]) == 1
+    srv.stop()
+    _t.sleep(0.05)
+    for _ in range(2):  # trip the PROBE breaker
+        assert conn.get_num_matched_blocks([5]) == 0
+    assert conn.stats["breaker_trips"] == 1
+    t0 = _t.monotonic()
+    assert conn.get_num_matched_blocks([5]) == 0  # skipped, not timed out
+    assert _t.monotonic() - t0 < 0.1
+    assert conn.stats["breaker_skips"] >= 1
+    # store comes back: the BULK path never tripped (probe failures must not
+    # conflate a tight-deadline probe with a dead store), so save works
+    # immediately — and its success hands the probe its trial back without
+    # waiting out the 30s cooldown
+    srv2 = RemoteKVStoreServer(host=srv.host, port=srv.port)
+    try:
+        srv2.start()
+        conn.save_blocks([6], [[1]], blocks)
+        assert conn.stats["errors"] == 2  # the two probe timeouts only
+        assert conn.get_num_matched_blocks([6]) == 1
+        assert conn._consec_errors == {"probe": 0, "bulk": 0}
+    finally:
+        srv2.stop()
+
+
+def test_bulk_outage_also_silences_probe():
+    """A tripped BULK breaker opens the probe path too — probing a dead store
+    from under the engine scheduling lock is the stall the breaker prevents."""
+    conn = RemoteKVConnector({"host": "127.0.0.1", "port": 9,
+                              "timeout_s": 0.2, "breaker_errors": 2,
+                              "breaker_cooldown_s": 30.0})
+    blocks = np.ones((1, 2, 2), np.float32)
+    for _ in range(2):
+        conn.save_blocks([1], [[1]], blocks)  # refused → bulk breaker trips
+    assert conn._consec_errors["bulk"] == 2
+    skips0 = conn.stats["breaker_skips"]
+    assert conn.get_num_matched_blocks([1]) == 0
+    assert conn.stats["breaker_skips"] == skips0 + 1  # skipped, not attempted
