@@ -70,6 +70,11 @@ class EngineConfig:
     # reference semantics elsewhere, "pallas" = force the Pallas kernel,
     # "reference" = gather+mask (models.transformer.ragged_paged_attention_xla).
     attn_impl: str = "auto"
+    # Long-context sequence parallelism: when mesh.sp > 1, serve self-contained
+    # single-sequence prefill steps through the zig-zag ring-attention program
+    # (ops/ring_attention.py) instead of GSPMD-annotated paged attention. The
+    # engine gates eligibility per step; decode always stays on the paged path.
+    sp_ring_attention: bool = True
     # Per-phase timing attribution (bench.py): forces a device sync after each
     # unified step so host/device/post are separable. Off in production serving —
     # the sync serializes host packing against in-flight device work.

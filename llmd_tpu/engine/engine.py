@@ -71,6 +71,8 @@ class EngineStats:
     eplb_rebalances: int = 0  # wide-EP expert-placement recomputes
     attn_backend: str = ""  # kernel provenance (bench/debug)
     moe_backend: str = ""
+    sp_attn_backend: Optional[str] = None  # ring layout when sp>1 wired in
+    n_ring_prefill_steps: int = 0  # unified steps served by the ring program
     # Per-phase wall-time attribution (bench.py breakdown — every serving-perf
     # number must be decomposable into where the time actually went):
     time_prefill_steps: float = 0.0  # wall inside unified (mixed/prefill) steps
@@ -226,26 +228,30 @@ class LLMEngine:
 
             return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))
 
-        def _unified(params, cache, tokens, positions, seq_slots, page_tables,
-                     kv_lens, cu_q_lens, num_seqs, lora_tok,
-                     mm_embeds=None, mm_mask=None):
-            """Flat mixed batch (prefill chunks + decode tokens); returns each
-            sequence's last-row logits [B, vocab]."""
-            # flat token dim shards over dp×sp jointly: data-parallel decode rows
-            # and sequence-parallel long prefills ride the same constraint
-            tokens = _bind(tokens, ("dp", "sp"))
-            positions = _bind(positions, ("dp", "sp"))
-            seq_slots = _bind(seq_slots, ("dp", "sp"))
-            hidden, cache, cnt = forward_core(
-                cfg, params, cache, tokens, positions, seq_slots, page_tables,
-                kv_lens, cu_q_lens=cu_q_lens, num_seqs=num_seqs, attn_impl=attn,
-                moe_matmul_impl=moe_impl,
-                lora_indices=lora_tok if use_lora else None, lora_scale=lora_scale,
-                mm_embeds=mm_embeds, mm_mask=mm_mask,
-            )
-            last_rows = jnp.clip(cu_q_lens[1 : B + 1] - 1, 0, NT - 1)  # [B]
-            logits = unembed(cfg, params, hidden[last_rows])  # [B, vocab]
-            return logits, cache, cnt
+        def _make_unified(attn_fn):
+            def _unified(params, cache, tokens, positions, seq_slots, page_tables,
+                         kv_lens, cu_q_lens, num_seqs, lora_tok,
+                         mm_embeds=None, mm_mask=None):
+                """Flat mixed batch (prefill chunks + decode tokens); returns each
+                sequence's last-row logits [B, vocab]."""
+                # flat token dim shards over dp×sp jointly: data-parallel decode
+                # rows and sequence-parallel long prefills ride the same constraint
+                tokens = _bind(tokens, ("dp", "sp"))
+                positions = _bind(positions, ("dp", "sp"))
+                seq_slots = _bind(seq_slots, ("dp", "sp"))
+                hidden, cache, cnt = forward_core(
+                    cfg, params, cache, tokens, positions, seq_slots, page_tables,
+                    kv_lens, cu_q_lens=cu_q_lens, num_seqs=num_seqs,
+                    attn_impl=attn_fn, moe_matmul_impl=moe_impl,
+                    lora_indices=lora_tok if use_lora else None,
+                    lora_scale=lora_scale,
+                    mm_embeds=mm_embeds, mm_mask=mm_mask,
+                )
+                last_rows = jnp.clip(cu_q_lens[1 : B + 1] - 1, 0, NT - 1)  # [B]
+                logits = unembed(cfg, params, hidden[last_rows])  # [B, vocab]
+                return logits, cache, cnt
+
+            return _unified
 
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
                           temp, top_k, top_p, key, steps_left, lora_idx):
@@ -308,9 +314,25 @@ class LLMEngine:
             return jnp.sum(hidden.astype(jnp.float32) * valid, axis=0), cache
 
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
-        self._unified_fn = jax.jit(_unified, **donate)
+        self._unified_fn = jax.jit(_make_unified(attn), **donate)
         self._decode_multi_fn = jax.jit(_decode_multi, **donate)
         self._embed_fn = jax.jit(_embed, **donate)
+        # SP long-context prefill: a second unified program whose attention is
+        # the zig-zag ring over the sp axis (ops/ring_attention.py), engaged
+        # host-side for self-contained single-sequence prefill steps only —
+        # the regime where the S² attention term lives and context parallelism
+        # pays (SURVEY §5 long-context; compiled lazily on first eligible step)
+        self._unified_ring_fn = None
+        self.sp_attn_backend: Optional[str] = None
+        if (mesh is not None and engine_cfg.mesh.sp > 1
+                and engine_cfg.sp_ring_attention and NT % engine_cfg.mesh.sp == 0):
+            from llmd_tpu.ops.ring_attention import make_ring_attn_impl
+
+            layout = "zigzag" if NT % (2 * engine_cfg.mesh.sp) == 0 else "contiguous"
+            ring = make_ring_attn_impl(mesh, axis_name="sp")
+            self._unified_ring_fn = jax.jit(_make_unified(ring), **donate)
+            self.sp_attn_backend = f"ring_{layout}(sp={engine_cfg.mesh.sp})"
+            self.stats.sp_attn_backend = self.sp_attn_backend
 
     # ------------------------------------------------------- kernel selection
     def _select_attn_impl(self):
@@ -970,7 +992,17 @@ class LLMEngine:
 
         t1 = time.perf_counter()
         mm_args = ((jnp.asarray(mm_embeds), jnp.asarray(mm_mask)) if is_vl else ())
-        logits, self.cache, cnt = self._unified_fn(
+        # ring-eligible: ONE fresh self-contained prefill chunk at offset 0
+        # (positions 0..n-1, no prior KV) — the only regime where causality by
+        # row index equals causality by position and in-chunk q/k/v are the
+        # whole attention problem (see make_ring_attn_impl)
+        step_fn = self._unified_fn
+        if (self._unified_ring_fn is not None and len(plan) == 1
+                and not plan[0][2] and plan[0][0].num_computed == 0
+                and pos[0] == 0 and not is_vl):
+            step_fn = self._unified_ring_fn
+            self.stats.n_ring_prefill_steps += 1
+        logits, self.cache, cnt = step_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(cu),
             jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok), *mm_args,

@@ -298,6 +298,8 @@ def ragged_paged_attention_xla(
     scale: float,
     cu_q_lens: Optional[jax.Array] = None,  # unused (uniform impl signature)
     num_seqs: Optional[jax.Array] = None,  # unused (uniform impl signature)
+    chunk_k: Optional[jax.Array] = None,  # unused (ring-attn impls only)
+    chunk_v: Optional[jax.Array] = None,  # unused (ring-attn impls only)
 ) -> jax.Array:
     """Reference-semantics ragged paged attention (gather + mask), jittable anywhere.
 
@@ -460,6 +462,7 @@ def forward_core(
             pad_heads(q), flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
             positions, seq_slots, kv_lens,
             cu_q_lens=cu_q_lens, num_seqs=num_seqs, scale=Dh ** -0.5,
+            chunk_k=pad_heads(k), chunk_v=pad_heads(v),
         )
         attn = attn[..., :Dh]
         o = jnp.einsum("nhk,hkd->nd", attn, lp["wo"])

@@ -97,10 +97,12 @@ def paged_attention_tpu(
     scale: float,
     cu_q_lens: jax.Array,  # [B+1] cumulative query lengths
     num_seqs: jax.Array,  # [1]
+    chunk_k: "jax.Array | None" = None,  # unused (ring-attn impls only)
+    chunk_v: "jax.Array | None" = None,  # unused (ring-attn impls only)
 ) -> jax.Array:
     """Uniform-signature adapter over the Pallas kernel (drop-in for
     models.transformer.ragged_paged_attention_xla on TPU)."""
-    del positions, seq_slots
+    del positions, seq_slots, chunk_k, chunk_v
     N = q.shape[0]
     _, ps, _, _ = layer_cache.shape
     bkv, bq = pick_block_sizes(N, ps, page_tables.shape[1])

@@ -226,6 +226,42 @@ def sp_flash_prefill(q, k, v, mesh, *, scale: Optional[float] = None,
     return out[inv]
 
 
+def make_ring_attn_impl(mesh, axis_name: str = "sp", zigzag: bool = True):
+    """Uniform-signature attention impl (drop-in for the engine's
+    ``attn_impl`` seam) that computes the step's attention ring-parallel over
+    ``mesh``'s sp axis, from the chunk's own q/k/v instead of the paged cache.
+
+    Valid ONLY for the self-contained prefill regime the engine gates host-side
+    (`LLMEngine._step_unified`): a single fresh sequence packed at offset 0,
+    positions 0..n-1, no prior KV — there, causality by row index equals
+    causality by position, trailing pad rows attend nothing real (their keys
+    sit strictly in every real query's future), and in-chunk q/k/v ARE the
+    whole attention problem. KV still lands in the paged cache (write_kv runs
+    before the attn call), so decode continues from the cache as usual.
+
+    GQA: KV heads are repeated up to the query head count before the ring —
+    correctness-first; a grouped-head ring (Hk lanes on the wire) is the
+    bandwidth follow-up.
+    """
+
+    def impl(q, layer_cache, page_tables, positions, seq_slots, kv_lens, *,
+             scale, cu_q_lens=None, num_seqs=None, chunk_k=None, chunk_v=None):
+        del layer_cache, page_tables, positions, seq_slots, kv_lens
+        del cu_q_lens, num_seqs
+        if chunk_k is None or chunk_v is None:
+            raise ValueError("ring attn impl needs the chunk's raw k/v "
+                             "(forward_core passes chunk_k/chunk_v)")
+        H, Hk = q.shape[1], chunk_k.shape[1]
+        if Hk != H:
+            reps = H // Hk
+            chunk_k = jnp.repeat(chunk_k, reps, axis=1)
+            chunk_v = jnp.repeat(chunk_v, reps, axis=1)
+        return sp_flash_prefill(q, chunk_k, chunk_v, mesh, scale=scale,
+                                axis_name=axis_name, zigzag=zigzag)
+
+    return impl
+
+
 def reference_causal_attention(q, k, v, scale: Optional[float] = None):
     """Dense causal attention (the correctness oracle for the ring path)."""
     if scale is None:
