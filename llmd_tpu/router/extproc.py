@@ -42,6 +42,15 @@ from llmd_tpu.router.server import RouterServer
 # Envoy's service/method name — what an ext_proc filter dials.
 ENVOY_SERVICE = "envoy.service.ext_proc.v3.ExternalProcessor"
 HDR_DESTINATION = "x-gateway-destination-endpoint"
+# Standard gRPC health protocol — Envoy's ext_proc cluster preset health-checks
+# the EPP with grpc_health_check (guides/no-kubernetes-deployment router
+# envoy.yaml in the reference); without this service a real Envoy marks the
+# EPP unhealthy and never opens a stream.
+HEALTH_SERVICE = "grpc.health.v1.Health"
+# grpc.health.v1.HealthCheckResponse { ServingStatus status = 1; } SERVING=1 —
+# hand-encoded (field 1, varint wire type, value 1); the 2-field health proto
+# doesn't warrant a generated module.
+_HEALTH_SERVING = b"\x08\x01"
 
 
 def _headers_to_dict(hm: pb.HeaderMap) -> dict[str, str]:
@@ -99,6 +108,9 @@ class ExtProcEPP:
         self.max_streams = max_streams
         self._server: Optional[grpc.Server] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        import threading
+
+        self._stopping = threading.Event()  # releases parked Watch streams
         self.metrics = {"streams_total": 0, "picks_total": 0,
                         "immediate_total": 0, "fail_open_total": 0}
 
@@ -117,8 +129,30 @@ class ExtProcEPP:
             request_deserializer=pb.ProcessingRequest.FromString,
             response_serializer=pb.ProcessingResponse.SerializeToString,
         )
+        health_check = grpc.unary_unary_rpc_method_handler(
+            lambda req, ctx: _HEALTH_SERVING,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
+        def _watch(req, ctx):
+            # the health protocol requires Watch to STAY OPEN and stream
+            # status changes — a completed stream reads as a failure to
+            # Watch-based checkers. One SERVING now, then hold until the
+            # server stops (our status never changes while serving).
+            yield _HEALTH_SERVING
+            while ctx.is_active() and not self._stopping.wait(timeout=1.0):
+                pass
+
+        health_watch = grpc.unary_stream_rpc_method_handler(
+            _watch,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
         self._server.add_generic_rpc_handlers((
             grpc.method_handlers_generic_handler(ENVOY_SERVICE, {"Process": rpc}),
+            grpc.method_handlers_generic_handler(
+                HEALTH_SERVICE, {"Check": health_check, "Watch": health_watch}),
         ))
         self.port = self._server.add_insecure_port(f"{self.host}:{self.port}")
         self._server.start()
@@ -126,6 +160,7 @@ class ExtProcEPP:
             self.router.extra_metrics.append(self.prometheus_lines)
 
     async def stop(self) -> None:
+        self._stopping.set()
         if self._server is not None:
             self._server.stop(grace=1.0)
 

@@ -45,6 +45,11 @@ def main() -> None:
     ap.add_argument("--extproc-port", type=int, default=None,
                     help="gateway mode: serve the Envoy ext_proc EPP gRPC here "
                          "(the HTTP port keeps serving /metrics and /health)")
+    ap.add_argument("--extproc-failure-mode", default=None,
+                    choices=["FailClose", "FailOpen"],
+                    help="override the InferencePool failureMode for the "
+                         "ext_proc EPP (no-kubernetes deployments have no "
+                         "pool manifest to read it from)")
     ap.add_argument("--vllmgrpc-port", type=int, default=None,
                     help="serve the vLLM gRPC API (Generate/Embed) here — the "
                          "vllmgrpc-parser front, scheduled like HTTP traffic")
@@ -141,8 +146,8 @@ def main() -> None:
             if len(modes) > 1:
                 print(f"warning: mixed failureModes {sorted(modes)}; "
                       "FailOpen wins for the shared EPP", flush=True)
-            failure_mode = ("FailOpen" if "FailOpen" in modes
-                            else "FailClose")
+            failure_mode = args.extproc_failure_mode or (
+                "FailOpen" if "FailOpen" in modes else "FailClose")
             epp = ExtProcEPP(server, host=args.host, port=args.extproc_port,
                              failure_mode=failure_mode)
             await epp.start()

