@@ -95,14 +95,27 @@ class PrecisePrefixCacheProducer(DataProducer):
     def __init__(self, ctx: dict[str, Any], blockSize: int = 16,
                  maxPrefixBlocks: int = 1024, maxKeys: int = 1_000_000,
                  maxPodsPerKey: int = 10, speculativeTTL: float = 2.0,
-                 tierWeights: Optional[dict[str, float]] = None) -> None:
+                 tierWeights: Optional[dict[str, float]] = None,
+                 indexBackend: str = "in-memory",
+                 indexParams: Optional[dict[str, Any]] = None) -> None:
         self.block_size = blockSize
         self.max_blocks = maxPrefixBlocks
-        self.index: KVBlockIndex = ctx.setdefault(
-            CTX_KV_INDEX,
-            KVBlockIndex(max_keys=maxKeys, max_pods_per_key=maxPodsPerKey,
-                         tier_weights=tierWeights, speculative_ttl_s=speculativeTTL),
-        )
+        if indexBackend == "in-memory":
+            index = KVBlockIndex(
+                max_keys=maxKeys, max_pods_per_key=maxPodsPerKey,
+                tier_weights=tierWeights, speculative_ttl_s=speculativeTTL)
+        else:
+            # cost-aware / external (kv-indexer.md backends table) —
+            # byte/host sizing lives in indexParams; the shared knobs
+            # (maxPodsPerKey etc.) carry over rather than silently resetting
+            # to backend defaults
+            from llmd_tpu.kv.index_backends import build_index
+
+            index = build_index(indexBackend, tier_weights=tierWeights,
+                                speculative_ttl_s=speculativeTTL,
+                                max_pods_per_key=maxPodsPerKey,
+                                **(indexParams or {}))
+        self.index: KVBlockIndex = ctx.setdefault(CTX_KV_INDEX, index)
 
     def produce(self, req: InferenceRequest, endpoints: list[Endpoint]) -> None:
         token_ids = req.state.get(STATE_TOKEN_IDS)
