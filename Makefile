@@ -1,0 +1,30 @@
+# Developer/CI entry points (role of the reference's root Makefile, whose
+# DEVICE matrix builds container images; ours gates the source tree).
+
+PY ?= python
+
+.PHONY: check check-quick test bench dryrun lint manifests
+
+# full gate: lint + manifests + suite + tiny bench + 8-device dryrun
+check:
+	$(PY) tools/ci_gate.py
+
+# PR-sized gate (fail-fast tests, 2-device dryrun)
+check-quick:
+	$(PY) tools/ci_gate.py --quick
+
+test:
+	$(PY) -m pytest tests/ -q
+
+lint:
+	$(PY) tools/lint_envvars.py
+
+manifests:
+	$(PY) tools/validate_manifests.py deploy
+
+bench:
+	$(PY) bench.py --tiny --cpu
+
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+		$(PY) __graft_entry__.py

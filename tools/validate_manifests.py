@@ -131,12 +131,28 @@ def _validate_container(path: str, dep: str, c: dict, iss: Issues) -> None:
                               f"not declared in containerPorts")
 
 
+def _validate_kustomization(path: str, doc: dict, iss: Issues) -> None:
+    """Kustomize dry-run essentials (the reference gates these in CI via
+    `kubectl kustomize`): every referenced resource/patch path must exist."""
+    base = os.path.dirname(path)
+    for res in doc.get("resources", []):
+        if not os.path.exists(os.path.join(base, str(res))):
+            iss.err(path, f"kustomization resource {res!r} does not exist")
+    for patch in doc.get("patches", []):
+        p = patch.get("path") if isinstance(patch, dict) else patch
+        if p and not os.path.exists(os.path.join(base, str(p))):
+            iss.err(path, f"kustomization patch {p!r} does not exist")
+
+
 def _validate_file(path: str, iss: Issues) -> None:
     with open(path) as f:
         docs = [d for d in yaml.safe_load_all(f) if d]
     crd_docs, deployments, pod_ports = [], [], set()
     for doc in docs:
         kind = doc.get("kind")
+        if kind == "Kustomization":  # has no metadata.name by design
+            _validate_kustomization(path, doc, iss)
+            continue
         if not kind or not doc.get("metadata", {}).get("name"):
             iss.err(path, f"document missing kind/metadata.name: {str(doc)[:80]}")
             continue
@@ -148,7 +164,29 @@ def _validate_file(path: str, iss: Issues) -> None:
             _validate_deployment(path, doc, iss)
             for c in _containers(doc):
                 pod_ports |= {p.get("containerPort") for p in c.get("ports", [])}
-        elif kind in ("Service", "ConfigMap", "Namespace"):
+        elif kind == "Gateway":
+            spec = doc.get("spec", {})
+            # base gateways declare listeners; variant patches must at least
+            # pin the gatewayClassName they exist to select
+            if not spec.get("listeners") and not spec.get("gatewayClassName"):
+                iss.err(path, f"Gateway {doc['metadata']['name']}: neither "
+                              "listeners nor gatewayClassName")
+        elif kind == "HTTPRoute":
+            for rule in doc.get("spec", {}).get("rules", []):
+                for ref in rule.get("backendRefs", []):
+                    if ref.get("kind") == "InferencePool" and not ref.get("name"):
+                        iss.err(path, "HTTPRoute backendRef InferencePool "
+                                      "without a name")
+        elif kind == "HorizontalPodAutoscaler":
+            spec = doc.get("spec", {})
+            if not spec.get("scaleTargetRef", {}).get("name"):
+                iss.err(path, f"HPA {doc['metadata']['name']}: no scaleTargetRef")
+            if not spec.get("metrics"):
+                iss.err(path, f"HPA {doc['metadata']['name']}: no metrics")
+        elif kind == "ScaledObject":
+            if not doc.get("spec", {}).get("triggers"):
+                iss.err(path, f"ScaledObject {doc['metadata']['name']}: no triggers")
+        elif kind in ("Service", "ConfigMap", "Namespace", "GatewayParameters"):
             pass
         else:
             iss.err(path, f"unexpected kind {kind!r}")
@@ -179,6 +217,8 @@ def validate(root: str) -> list[str]:
     if not files:
         iss.err(root, "no manifest files found")
     for path in files:
+        if os.path.basename(os.path.dirname(path)) == "standalone-envoy":
+            continue  # Envoy bootstrap config, not a Kubernetes manifest
         _validate_file(path, iss)
     return iss.errors
 
