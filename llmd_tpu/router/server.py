@@ -125,6 +125,19 @@ class RouterServer:
         self.pool = pool
         self.host, self.port = host, port
         self.ctx: dict[str, Any] = {}
+        kv_cfg = (config.raw.get("kvEvents") or {}) if config.raw else {}
+        if kv_cfg.get("indexBackend") or kv_cfg.get("indexParams"):
+            # seed the index BEFORE plugin construction: the precise-prefix
+            # producer setdefaults CTX_KV_INDEX at plugin-build time, so a
+            # kvEvents-configured backend created later would be constructed
+            # and silently discarded (each replica running a private
+            # in-memory index instead of the configured shared one)
+            from llmd_tpu.kv.index_backends import build_index
+            from llmd_tpu.kv.plugins import CTX_KV_INDEX
+
+            self.ctx[CTX_KV_INDEX] = build_index(
+                kv_cfg.get("indexBackend", "in-memory"),
+                **(kv_cfg.get("indexParams") or {}))
         self.scheduler = Scheduler(config, pool, self.ctx)
         self.flow: Optional[FlowController] = (
             FlowController(config.flow_control, pool, self.ctx)
@@ -138,14 +151,15 @@ class RouterServer:
         # KV-event subscription (precise prefix routing): on when the config declares
         # a precise producer or an explicit kvEvents section (kv-indexer.md:67-87).
         self.kv_subscriber = None
-        kv_cfg = (config.raw.get("kvEvents") or {}) if config.raw else {}
         wants_precise = any(p.type == "precise-prefix-cache-producer" for p in config.plugins)
         if wants_precise or (config.raw and "kvEvents" in config.raw):
-            from llmd_tpu.kv.indexer import KVBlockIndex
+            from llmd_tpu.kv.index_backends import build_index
             from llmd_tpu.kv.plugins import CTX_KV_INDEX
             from llmd_tpu.kv.subscriber import KVEventSubscriberManager
 
-            index = self.ctx.setdefault(CTX_KV_INDEX, KVBlockIndex())
+            index = self.ctx.setdefault(CTX_KV_INDEX, build_index(
+                kv_cfg.get("indexBackend", "in-memory"),
+                **(kv_cfg.get("indexParams") or {})))
             self.kv_subscriber = KVEventSubscriberManager(
                 index, pool,
                 topic_filter=kv_cfg.get("topicFilter", "kv@"),
@@ -209,6 +223,10 @@ class RouterServer:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
         app.router.add_get("/v1/models", self._models)
+        # runtime canary control: the rollout driver (tools/rollout.py) shifts
+        # InferenceModelRewrite weights through here stage by stage
+        app.router.add_get("/admin/model-rewrites", self._get_rewrites)
+        app.router.add_post("/admin/model-rewrites", self._set_rewrites)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -228,6 +246,47 @@ class RouterServer:
         self._sched_executor.shutdown(wait=False)
 
     # ------------------------------------------------------------------
+    async def _get_rewrites(self, request: web.Request):
+        return web.json_response({
+            m: [[t, w] for t, w in targets]
+            for m, targets in self.model_rewrites.items()
+        })
+
+    async def _set_rewrites(self, request: web.Request):
+        """Merge-update rewrite entries: {"model": [["target", weight], ...]}.
+        An empty target list deletes the entry (traffic reverts to the plain
+        model name). The rollout driver shifts canary weights through this."""
+        import math
+
+        try:
+            body = await request.json()
+            updates = {
+                m: [(str(t), float(w)) for t, w in targets]
+                for m, targets in body.items()
+            }
+        except Exception:
+            return web.json_response(
+                {"error": "body must be {model: [[target, weight], ...]}"},
+                status=400)
+        for m, targets in updates.items():
+            # NaN/inf pass both the <0 and <=0 checks and then poison
+            # random.choices' cumulative weights (every comparison False →
+            # deterministic first pick): finite-and-nonnegative only
+            if any(not math.isfinite(w) or w < 0 for _, w in targets):
+                return web.json_response(
+                    {"error": f"rewrite {m}: weights must be finite and >= 0"},
+                    status=400)
+            if targets and sum(w for _, w in targets) <= 0:
+                return web.json_response(
+                    {"error": f"rewrite {m}: zero total weight"}, status=400)
+        for m, targets in updates.items():
+            if targets:
+                self.model_rewrites[m] = targets
+            else:
+                self.model_rewrites.pop(m, None)
+        return web.json_response({"status": "ok",
+                                  "rewrites": len(self.model_rewrites)})
+
     def _rewrite_model(self, req: InferenceRequest, body: dict) -> None:
         """InferenceModelRewrite: weighted model-name rewrite for canary/A-B
         (docs/api-reference/inferencemodelrewrite.md)."""
