@@ -328,8 +328,12 @@ class LLMEngine:
                 and engine_cfg.sp_ring_attention and NT % engine_cfg.mesh.sp == 0):
             from llmd_tpu.ops.ring_attention import make_ring_attn_impl
 
+            # ONE layout decision, passed down — sp_flash_prefill would
+            # otherwise re-derive it independently and a future change to its
+            # degrade condition would make this provenance label lie
             layout = "zigzag" if NT % (2 * engine_cfg.mesh.sp) == 0 else "contiguous"
-            ring = make_ring_attn_impl(mesh, axis_name="sp")
+            ring = make_ring_attn_impl(mesh, axis_name="sp",
+                                       zigzag=(layout == "zigzag"))
             self._unified_ring_fn = jax.jit(_make_unified(ring), **donate)
             self.sp_attn_backend = f"ring_{layout}(sp={engine_cfg.mesh.sp})"
             self.stats.sp_attn_backend = self.sp_attn_backend

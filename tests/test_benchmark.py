@@ -104,3 +104,53 @@ def test_scheduler_beats_round_robin_on_shared_prefix():
         f"scheduler should beat RR comfortably on shared-prefix, got {ratio:.3f} "
         f"(epp {epp['out_tok_per_s']} vs rr {rr['out_tok_per_s']} tok/s)")
     assert epp["ttft_mean_ms"] < rr["ttft_mean_ms"]
+
+
+def test_rate_ladder_matrix_reports_knees():
+    """Ladder mode (VERDICT r4 #9): rate sweep x 2 profiles x {RR, EPP}, a
+    saturation knee per target, and the EPP's knee >= RR's on shared-prefix."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "run_sched_comparison",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "run_sched_comparison.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    report = run_async(mod.run_ladder_matrix(servers=2, requests=24,
+                                             rates=[4.0, 16.0]))
+    assert set(report["profiles"]) == {"shared-prefix", "long-prompt"}
+    for prof in report["profiles"].values():
+        for t in ("round_robin", "epp_scheduler"):
+            tgt = prof["targets"][t]
+            assert len(tgt["ladder"]) == 2
+            assert all(r["errors"] == 0 for r in tgt["ladder"])
+            assert "knee_qps" in tgt
+    sp = report["profiles"]["shared-prefix"]["targets"]
+    assert (sp["epp_scheduler"]["knee_qps"]
+            >= sp["round_robin"]["knee_qps"])
+
+
+def test_knee_detection_logic():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "run_sched_comparison2",
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "run_sched_comparison.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    rungs = [
+        {"rate_qps": 4, "req_per_s": 3.4, "ttft_p90_ms": 100.0},
+        {"rate_qps": 8, "req_per_s": 6.9, "ttft_p90_ms": 120.0},
+        {"rate_qps": 16, "req_per_s": 9.0, "ttft_p90_ms": 900.0},  # runaway
+    ]
+    k = mod._knee(rungs)
+    assert k["knee_qps"] == 8 and k["ttft_p90_ms_at_knee"] == 120.0
+    # absorption failure alone also caps the knee
+    rungs[2] = {"rate_qps": 16, "req_per_s": 5.0, "ttft_p90_ms": 140.0}
+    assert mod._knee(rungs)["knee_qps"] == 8

@@ -39,67 +39,156 @@ schedulingProfiles:
 """
 
 
-async def run(servers: int, requests: int, concurrency: int) -> dict:
-    from llmd_tpu.benchmark.harness import WorkloadSpec, compare_targets
-    from llmd_tpu.core.config import FrameworkConfig
-    from llmd_tpu.core.endpoint import Endpoint, EndpointPool
-    from llmd_tpu.engine.dp_group import DPLocalBalancer
-    from llmd_tpu.kv import plugins as _kv  # noqa: F401
-    from llmd_tpu.kv.subscriber import LABEL_KV_EVENTS_ADDR
-    from llmd_tpu.router import plugins as _p  # noqa: F401
-    from llmd_tpu.router import scorers as _s  # noqa: F401
-    from llmd_tpu.router.plugins import known_plugin_types
-    from llmd_tpu.router.server import RouterServer
-    from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+class _Fixture:
+    """N fake servers + RR proxy + EPP router (fresh per measurement so cache
+    warmth never leaks between compared targets)."""
 
-    fakes = [
-        FakeModelServer(FakeServerConfig(
-            kv_events_port=0,
-            prefill_us_per_token=800.0,  # uncached prefill dominates (cache wins)
-            decode_us_per_token=150.0,
-            # bounded HBM cache: the EPP's sticky placement (groups/N per pod)
-            # fits; RR smears every group onto every pod and thrashes the LRU —
-            # the mechanism behind the reference's +130% headline
-            num_blocks=160,
-        ))
-        for _ in range(servers)
-    ]
-    for f in fakes:
-        await f.start()
+    def __init__(self, servers: int, max_running: int = 8) -> None:
+        self.n = servers
+        self.max_running = max_running
 
-    rr = DPLocalBalancer([f.address for f in fakes])
-    await rr.start()
+    async def __aenter__(self):
+        from llmd_tpu.core.config import FrameworkConfig
+        from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+        from llmd_tpu.engine.dp_group import DPLocalBalancer
+        from llmd_tpu.kv import plugins as _kv  # noqa: F401
+        from llmd_tpu.kv.subscriber import LABEL_KV_EVENTS_ADDR
+        from llmd_tpu.router import plugins as _p  # noqa: F401
+        from llmd_tpu.router import scorers as _s  # noqa: F401
+        from llmd_tpu.router.plugins import known_plugin_types
+        from llmd_tpu.router.server import RouterServer
+        from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
 
-    pool = EndpointPool()
-    for f in fakes:
-        pool.upsert(Endpoint(
-            address=f.address,
-            labels={LABEL_KV_EVENTS_ADDR: f"127.0.0.1:{f.cfg.kv_events_port}"},
-        ))
-    cfg = FrameworkConfig.from_yaml(ROUTER_CFG, known_types=known_plugin_types())
-    router = RouterServer(cfg, pool, port=0, poll_interval_s=0.2)
-    await router.start()
-    await asyncio.sleep(0.4)  # SUB slow joiner
+        self.fakes = [
+            FakeModelServer(FakeServerConfig(
+                kv_events_port=0,
+                prefill_us_per_token=800.0,  # uncached prefill dominates (cache wins)
+                decode_us_per_token=150.0,
+                # bounded HBM cache: the EPP's sticky placement (groups/N per pod)
+                # fits; RR smears every group onto every pod and thrashes the LRU —
+                # the mechanism behind the reference's +130% headline
+                num_blocks=160,
+                max_running=self.max_running,
+            ))
+            for _ in range(self.n)
+        ]
+        for f in self.fakes:
+            await f.start()
+        self.rr = DPLocalBalancer([f.address for f in self.fakes])
+        await self.rr.start()
+        pool = EndpointPool()
+        for f in self.fakes:
+            pool.upsert(Endpoint(
+                address=f.address,
+                labels={LABEL_KV_EVENTS_ADDR: f"127.0.0.1:{f.cfg.kv_events_port}"},
+            ))
+        cfg = FrameworkConfig.from_yaml(ROUTER_CFG,
+                                        known_types=known_plugin_types())
+        self.router = RouterServer(cfg, pool, port=0, poll_interval_s=0.2)
+        await self.router.start()
+        await asyncio.sleep(0.4)  # SUB slow joiner
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.router.stop()
+        await self.rr.stop()
+        for f in self.fakes:
+            await f.stop()
+
+    @property
+    def note(self) -> dict:
+        return {
+            "servers": self.n,
+            "note": "fake model servers, prefix-cache timing model "
+                    "(prefill 800us/uncached tok, decode 150us/tok)",
+        }
+
+
+def _profiles(servers: int, requests: int) -> dict:
+    from llmd_tpu.benchmark.harness import WorkloadSpec
 
     # more groups than servers: RR necessarily splits groups across pods
-    # (recomputing prefixes), the EPP keeps each group sticky to its cache
-    spec = WorkloadSpec(kind="shared-prefix", num_requests=requests,
-                        max_tokens=24, prefix_groups=2 * servers,
-                        prefix_words=160, prompt_words=200)
-    report = await compare_targets(
-        {"round_robin": rr.address, "epp_scheduler": router.address},
-        spec, concurrency=concurrency,
-    )
-    report["fixture"] = {
-        "servers": servers,
-        "note": "fake model servers, prefix-cache timing model "
-                "(prefill 800us/uncached tok, decode 150us/tok)",
+    # (recomputing prefixes), the EPP keeps each group sticky to its cache.
+    # long-prompt sizes service time (~1.3 s at 800 us/byte-token) so the
+    # ladder's upper rungs exceed pool capacity and the knee is observable
+    # with max_running=4 slots per pod.
+    return {
+        "shared-prefix": WorkloadSpec(
+            kind="shared-prefix", num_requests=requests, max_tokens=24,
+            prefix_groups=2 * servers, prefix_words=160, prompt_words=200),
+        "long-prompt": WorkloadSpec(
+            kind="long-context", num_requests=requests,
+            max_tokens=24, long_prompt_words=300),
     }
 
-    await router.stop()
-    await rr.stop()
-    for f in fakes:
-        await f.stop()
+
+async def run(servers: int, requests: int, concurrency: int) -> dict:
+    from llmd_tpu.benchmark.harness import compare_targets
+
+    spec = _profiles(servers, requests)["shared-prefix"]
+    async with _Fixture(servers) as fx:
+        report = await compare_targets(
+            {"round_robin": fx.rr.address, "epp_scheduler": fx.router.address},
+            spec, concurrency=concurrency,
+        )
+        report["fixture"] = fx.note
+    return report
+
+
+def _knee(rungs: list[dict]) -> dict:
+    """Saturation knee: the highest offered rate the target still absorbs.
+
+    Two signals, both required (the reference reads its QPS sweeps the same
+    way — optimized-baseline README ladder plots):
+    - latency stays bounded: p90 TTFT within 2.5x of the *lowest* rung's p90
+      (an unsaturated open-loop rung serves at service latency; a saturated
+      one queues, and p90 runs away with offered load);
+    - the measured completion rate tracks offered rate within the open-loop
+      wall-clock tail (>= 70% — the wall includes the Poisson send window
+      plus the last request's service time, so 100% is unreachable even idle).
+    """
+    base_p90 = min((r["ttft_p90_ms"] for r in rungs
+                    if r["ttft_p90_ms"] is not None), default=None)
+    knee_rate, knee_rung = 0.0, None
+    for r in rungs:
+        bounded = (base_p90 is None or r["ttft_p90_ms"] is None
+                   or r["ttft_p90_ms"] <= 2.5 * base_p90)
+        absorbing = r["req_per_s"] >= 0.7 * r["rate_qps"]
+        if bounded and absorbing and r["rate_qps"] > knee_rate:
+            knee_rate, knee_rung = r["rate_qps"], r
+    return {
+        "knee_qps": knee_rate,
+        "ttft_p90_ms_at_knee": knee_rung["ttft_p90_ms"] if knee_rung else None,
+    }
+
+
+async def run_ladder_matrix(servers: int, requests: int,
+                            rates: list[float]) -> dict:
+    """Rate ladder x {shared-prefix, long-prompt} x {RR, EPP} (VERDICT r4 #9).
+
+    Fresh fixture per (profile, target): within one target's ladder the rungs
+    share warm caches (steady-state, like a real QPS sweep), but RR and EPP
+    never inherit each other's cache state.
+    """
+    from llmd_tpu.benchmark.harness import run_ladder
+
+    report: dict = {"rates_qps": rates, "profiles": {}}
+    for pname, spec in _profiles(servers, requests).items():
+        prof: dict = {"workload": spec.describe(), "targets": {}}
+        for tname in ("round_robin", "epp_scheduler"):
+            # 4 slots/pod: pool capacity sits inside the ladder's range, so
+            # upper rungs genuinely saturate and the knee is measurable
+            async with _Fixture(servers, max_running=4) as fx:
+                addr = fx.rr.address if tname == "round_robin" else fx.router.address
+                ladder = await run_ladder(addr, spec, rates)
+                prof["targets"][tname] = {
+                    "ladder": ladder["ladder"], **_knee(ladder["ladder"]),
+                }
+                report.setdefault("fixture", fx.note)
+        rrk = prof["targets"]["round_robin"]["knee_qps"]
+        eppk = prof["targets"]["epp_scheduler"]["knee_qps"]
+        prof["delta"] = {"epp_vs_rr_knee": round(eppk / rrk, 3) if rrk else None}
+        report["profiles"][pname] = prof
     return report
 
 
@@ -121,17 +210,33 @@ def main() -> None:
     ap.add_argument("--requests", type=int, default=96)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--real-target", nargs=2, metavar=("RR", "EPP"), default=None)
+    ap.add_argument("--ladder", default=None,
+                    help="comma-separated QPS rungs: sweep the rate ladder over "
+                         "BOTH workload profiles per target and report the "
+                         "saturation knee (writes the matrix artifact)")
     args = ap.parse_args()
     if args.real_target:
         report = asyncio.run(run_real(*args.real_target, args.requests,
                                       args.concurrency))
+    elif args.ladder:
+        rates = [float(r) for r in args.ladder.split(",")]
+        report = asyncio.run(run_ladder_matrix(args.servers, args.requests, rates))
     else:
         report = asyncio.run(run(args.servers, args.requests, args.concurrency))
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
-    d = report.get("delta", {})
-    print(json.dumps({"out": args.out, **report["targets"], **d}, indent=2))
+    if "profiles" in report:  # ladder matrix: print the knee summary
+        summary = {
+            p: {t: {"knee_qps": d["knee_qps"],
+                    "ttft_p90_ms_at_knee": d["ttft_p90_ms_at_knee"]}
+                for t, d in prof["targets"].items()} | prof["delta"]
+            for p, prof in report["profiles"].items()
+        }
+        print(json.dumps({"out": args.out, **summary}, indent=2))
+    else:
+        d = report.get("delta", {})
+        print(json.dumps({"out": args.out, **report["targets"], **d}, indent=2))
 
 
 if __name__ == "__main__":
