@@ -82,9 +82,8 @@ def test_load_generation_against_fake_server():
     run_async(main())
 
 
-def test_scheduler_beats_round_robin_on_shared_prefix():
-    """The headline property, hardware-free: prefix-aware scheduling beats RR
-    when the shared-prefix working set only fits if placement is sticky."""
+def _sched_tool():
+    """Load tools/run_sched_comparison.py (a script, not an importable module)."""
     import importlib.util
     import os
 
@@ -94,6 +93,13 @@ def test_scheduler_beats_round_robin_on_shared_prefix():
                      "tools", "run_sched_comparison.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def test_scheduler_beats_round_robin_on_shared_prefix():
+    """The headline property, hardware-free: prefix-aware scheduling beats RR
+    when the shared-prefix working set only fits if placement is sticky."""
+    mod = _sched_tool()
 
     report = run_async(mod.run(servers=3, requests=60, concurrency=6))
     rr = report["targets"]["round_robin"]
@@ -109,15 +115,7 @@ def test_scheduler_beats_round_robin_on_shared_prefix():
 def test_rate_ladder_matrix_reports_knees():
     """Ladder mode (VERDICT r4 #9): rate sweep x 2 profiles x {RR, EPP}, a
     saturation knee per target, and the EPP's knee >= RR's on shared-prefix."""
-    import importlib.util
-    import os
-
-    spec = importlib.util.spec_from_file_location(
-        "run_sched_comparison",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     "tools", "run_sched_comparison.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _sched_tool()
 
     report = run_async(mod.run_ladder_matrix(servers=2, requests=24,
                                              rates=[4.0, 16.0]))
@@ -134,15 +132,7 @@ def test_rate_ladder_matrix_reports_knees():
 
 
 def test_knee_detection_logic():
-    import importlib.util
-    import os
-
-    spec = importlib.util.spec_from_file_location(
-        "run_sched_comparison2",
-        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                     "tools", "run_sched_comparison.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    mod = _sched_tool()
 
     rungs = [
         {"rate_qps": 4, "req_per_s": 3.4, "ttft_p90_ms": 100.0},

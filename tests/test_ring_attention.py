@@ -134,3 +134,18 @@ def test_ring_not_engaged_for_continuation_or_batch():
     while eng2.has_work():
         eng2.step()
     assert eng2.stats.n_ring_prefill_steps == 0  # two-sequence pack → paged
+
+
+def test_ring_gqa_native_matches_repeated_oracle():
+    """GQA: k/v ride the ring at Hk heads; result must equal dense attention
+    with the KV heads repeated to the query head count."""
+    ks = jax.random.split(jax.random.PRNGKey(21), 3)
+    S, H, Hk, D = 64, 8, 2, 32
+    q = jax.random.normal(ks[0], (S, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (S, Hk, D), jnp.float32)
+    v = jax.random.normal(ks[2], (S, Hk, D), jnp.float32)
+    want = reference_causal_attention(q, k, v)
+    for zigzag in (False, True):
+        got = sp_flash_prefill(q, k, v, _mesh(4), zigzag=zigzag)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)

@@ -1,0 +1,80 @@
+"""Serving-bench sweep: run bench.py across batch / fused-decode-step
+configurations on the real chip and report the winner.
+
+The r4 review's cheapest bandwidth-utilization lever is batch size (at batch
+32 a 2 GB bf16 model caps at ~12.8k tok/s on a v5e's 819 GB/s; doubling the
+batch halves the per-token weights traffic), so the sweep defaults to
+batch x {32, 64, 128} at the current decode-step default, each point a full
+bench.py run in a FRESH subprocess (engine shapes differ per point; a shared
+process would also share a poisoned backend on failure). Writes one JSON with
+every point + the argmax so the best config can be promoted to bench.py's
+defaults with evidence attached.
+
+Usage: python tools/bench_sweep.py [--batches 32,64,128] [--decode-steps 32]
+                                   [--cpu] [--tiny] [--out BENCH_SWEEP.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_point(batch: int, decode_steps: int | None, extra: list[str],
+              timeout_s: float) -> dict:
+    cmd = [sys.executable, os.path.join(ROOT, "bench.py"), "--batch", str(batch)]
+    if decode_steps:
+        cmd += ["--decode-steps", str(decode_steps)]
+    cmd += extra
+    print(f"=== sweep point: {' '.join(cmd)}", flush=True)
+    try:
+        p = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"batch": batch, "error": f"timeout after {timeout_s:.0f}s"}
+    sys.stderr.write(p.stderr[-2000:])
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        out["batch_requested"] = batch
+        return out
+    return {"batch": batch, "error": f"no JSON (rc={p.returncode})",
+            "tail": (p.stderr or p.stdout)[-500:]}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", default="32,64,128")
+    ap.add_argument("--decode-steps", type=int, default=None)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--timeout", type=float, default=1800.0)
+    ap.add_argument("--out", default="BENCH_SWEEP.json")
+    args = ap.parse_args()
+    extra = (["--cpu"] if args.cpu else []) + (["--tiny"] if args.tiny else [])
+
+    points = [run_point(int(b), args.decode_steps, extra, args.timeout)
+              for b in args.batches.split(",")]
+    valid = [p for p in points if p.get("value")]
+    best = max(valid, key=lambda p: p["value"]) if valid else None
+    report = {
+        "sweep": "batch",
+        "points": points,
+        "best": {k: best[k] for k in ("batch", "value", "weights_bw_util",
+                                      "decode_mfu")
+                 if best and k in best} if best else None,
+    }
+    with open(os.path.join(ROOT, args.out), "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report["best"] or {"error": "no valid points"}))
+
+
+if __name__ == "__main__":
+    main()

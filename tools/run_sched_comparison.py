@@ -48,6 +48,16 @@ class _Fixture:
         self.max_running = max_running
 
     async def __aenter__(self):
+        # __aexit__ never runs when __aenter__ raises: a mid-startup failure
+        # (port bind, config error) must stop whatever already started or the
+        # stranded servers bleed into every later fixture in the process
+        try:
+            return await self._enter()
+        except BaseException:
+            await self.__aexit__()
+            raise
+
+    async def _enter(self):
         from llmd_tpu.core.config import FrameworkConfig
         from llmd_tpu.core.endpoint import Endpoint, EndpointPool
         from llmd_tpu.engine.dp_group import DPLocalBalancer
@@ -90,9 +100,11 @@ class _Fixture:
         return self
 
     async def __aexit__(self, *exc):
-        await self.router.stop()
-        await self.rr.stop()
-        for f in self.fakes:
+        if getattr(self, "router", None) is not None:
+            await self.router.stop()
+        if getattr(self, "rr", None) is not None:
+            await self.rr.stop()
+        for f in getattr(self, "fakes", []):
             await f.stop()
 
     @property
@@ -140,9 +152,10 @@ def _knee(rungs: list[dict]) -> dict:
 
     Two signals, both required (the reference reads its QPS sweeps the same
     way — optimized-baseline README ladder plots):
-    - latency stays bounded: p90 TTFT within 2.5x of the *lowest* rung's p90
-      (an unsaturated open-loop rung serves at service latency; a saturated
-      one queues, and p90 runs away with offered load);
+    - latency stays bounded: p90 TTFT within 2.5x of the MINIMUM p90 across
+      rungs (the floor of some unsaturated rung — more robust than rung 0,
+      whose p90 can be inflated by cold-start; a saturated rung queues and
+      its p90 runs away with offered load);
     - the measured completion rate tracks offered rate within the open-loop
       wall-clock tail (>= 70% — the wall includes the Poisson send window
       plus the last request's service time, so 100% is unreachable even idle).
