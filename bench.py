@@ -104,6 +104,9 @@ def main() -> None:
     ap.add_argument("--decode-steps", type=int, default=None)
     ap.add_argument("--isl", type=int, default=None)
     ap.add_argument("--osl", type=int, default=None)
+    ap.add_argument("--quantize", default=None, choices=["int8"],
+                    help="weight-only quantization (halves decode's HBM "
+                         "weight traffic; models/quant.py)")
     args = ap.parse_args()
     tiny = args.tiny
     if args.cpu:
@@ -159,6 +162,8 @@ def main() -> None:
         eng_cfg.max_num_batched_tokens = max(eng_cfg.batched_tokens, args.batch * 8)
     if args.decode_steps:
         eng_cfg.decode_steps = args.decode_steps
+    if args.quantize:
+        eng_cfg.quantize_weights = args.quantize
     # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
     # the latency the pipelined decode path exists to hide
     import jax.numpy as jnp
@@ -334,7 +339,11 @@ def main() -> None:
     # --- provenance / roofline context -------------------------------------
     st = eng.stats
     n_params = _param_count(cfg)
-    bytes_per_param = 2 if cfg.dtype == "bfloat16" else 4
+    # int8 weight-only serves ~1 byte/param for the dense per-step stream
+    # (scales are per-channel, negligible); the weights-BW estimate must use
+    # the bytes actually read or utilization overstates 2x
+    bytes_per_param = (1 if eng_cfg.quantize_weights == "int8"
+                       else 2 if cfg.dtype == "bfloat16" else 4)
     peak_tflops, peak_gbs = _chip_peaks(getattr(dev, "device_kind", ""))
     # decode reads all weights once per step for max_batch_size tokens
     model_gb = n_params * bytes_per_param / 1e9
@@ -357,7 +366,8 @@ def main() -> None:
           f"post {st.time_postprocess:.2f}s "
           f"({st.n_unified_steps} unified + {st.n_decode_calls} decode calls; "
           f"{dev_ms_per_decode:.1f} ms device/decode-call)", file=sys.stderr)
-    print(f"# model {n_params/1e9:.2f}B params ({model_gb:.2f} GB bf16); "
+    wdtype = "int8" if eng_cfg.quantize_weights == "int8" else cfg.dtype
+    print(f"# model {n_params/1e9:.2f}B params ({model_gb:.2f} GB {wdtype}); "
           f"weights-BW {achieved_gbs:.0f} GB/s of ~{peak_gbs:.0f} peak "
           f"({achieved_gbs/peak_gbs*100:.0f}%); decode-MFU {mfu*100:.2f}%",
           file=sys.stderr)
@@ -368,6 +378,7 @@ def main() -> None:
         "unit": "tok/s",
         "vs_baseline": round(tput / 3100.0, 4),
         "weights": weights_src,
+        "quantize": eng_cfg.quantize_weights,
         "attn_backend": eng.attn_backend,
         "attn_fallback_reason": eng.attn_fallback_reason,
         "moe_backend": eng.moe_backend,

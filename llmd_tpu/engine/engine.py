@@ -168,10 +168,31 @@ class LLMEngine:
 
         if params is None:
             params = init_params(model_cfg, jax.random.PRNGKey(seed))
+        param_axes = param_logical_axes(model_cfg)
+        if engine_cfg.quantize_weights:
+            if engine_cfg.quantize_weights != "int8":
+                raise ValueError(
+                    f"unknown quantize_weights={engine_cfg.quantize_weights!r}"
+                    " (supported: 'int8')")
+            if model_cfg.is_moe:
+                # the expert banks dominate an MoE weight stream and stay
+                # bf16 (Pallas grouped-GEMM path) — quantizing only the
+                # attention projections would be a silent near-no-op while
+                # the operator believes decode traffic was halved
+                raise ValueError(
+                    "quantize_weights='int8' does not support MoE models yet"
+                    " (expert banks would stay bf16; benefit ~none)")
+            from llmd_tpu.models.quant import quantize_params
+
+            # before sharding: the returned axes dict matches the new tree,
+            # so meshed runs shard _q/_scale leaves like their bf16 ancestors
+            params, param_axes = quantize_params(model_cfg, params,
+                                                 base_axes=param_axes)
+        self.quantization = engine_cfg.quantize_weights
         if self.mesh is not None:
             from llmd_tpu.parallel.mesh import shard_pytree
 
-            params = shard_pytree(params, self.mesh, param_logical_axes(model_cfg))
+            params = shard_pytree(params, self.mesh, param_axes)
         self.params = params
         self.cache = init_cache(model_cfg, engine_cfg.num_pages, engine_cfg.page_size)
         if self.mesh is not None:

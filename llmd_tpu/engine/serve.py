@@ -42,6 +42,9 @@ def main() -> None:
                          "unless that is a bind-any address)")
     ap.add_argument("--tokenizer", default=None, help="local HF tokenizer dir")
     ap.add_argument("--role", default="both", choices=["both", "prefill", "decode"])
+    ap.add_argument("--quantize", default=None, choices=["int8"],
+                    help="weight-only quantization: halves decode's HBM "
+                         "weight traffic (models/quant.py)")
     ap.add_argument("--cpu-offload-pages", type=int, default=0,
                     help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
     ap.add_argument("--offload-fs-path", default=None,
@@ -87,6 +90,7 @@ def main() -> None:
         offload_fs_path=args.offload_fs_path,
         mesh=MeshConfig(dp=args.dp, sp=args.sp, ep=args.ep, tp=args.tp),
         dp_ranks=args.dp,
+        quantize_weights=args.quantize,
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig

@@ -164,10 +164,17 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def swiglu(x: jax.Array, wi: jax.Array, wo: jax.Array) -> jax.Array:
-    gate_up = jnp.einsum("...d,df->...f", x, wi)
+def swiglu(x: jax.Array, wi: jax.Array, wo: jax.Array, mm=None) -> jax.Array:
+    """Fused gate/up MLP. ``mm(key, pattern, x)`` overrides the two matmuls
+    (the int8 weight-only path injects its scaled-dot here — ONE body for
+    both precisions, no drift hazard); wi/wo may be None when mm supplies
+    the weights itself."""
+    if mm is None:
+        def mm(key, pattern, xin, _w={"wi": wi, "wo_mlp": wo}):
+            return jnp.einsum(pattern, xin, _w[key])
+    gate_up = mm("wi", "...d,df->...f", x)
     gate, up = jnp.split(gate_up, 2, axis=-1)
-    return jnp.einsum("...f,fd->...d", jax.nn.silu(gate) * up, wo)
+    return mm("wo_mlp", "...f,fd->...d", jax.nn.silu(gate) * up)
 
 
 def moe_block(
@@ -404,12 +411,20 @@ def forward_core(
     safe_page = jnp.where(page_tables >= 0, page_tables, 0)[b, pidx]
     slots = jnp.where(positions >= 0, safe_page * ps + positions % ps, -1)  # [N]
 
-    stacked_keys = ("attn_norm", "mlp_norm", "wq", "wk", "wv", "wo") + (
+    def _variants(*keys):
+        # a weight-only-quantized model carries <key>_q + <key>_scale instead
+        # of <key> (models/quant.py); the scan consumes whichever is present
+        out: tuple[str, ...] = ()
+        for k in keys:
+            out += (k,) if k in params else (k + "_q", k + "_scale")
+        return out
+
+    stacked_keys = ("attn_norm", "mlp_norm") + _variants("wq", "wk", "wv", "wo") + (
         ("q_norm", "k_norm") if cfg.qk_norm else ()
     ) + (("bq", "bk", "bv", "bo") if cfg.attn_bias else ()) + (
         ("router", "moe_wi", "moe_wo") + (("shared_wi", "shared_wo") if cfg.moe_num_shared_experts else ())
         if cfg.is_moe
-        else ("wi", "wo_mlp")
+        else _variants("wi", "wo_mlp")
     )
     if "eplb_replica_slots" in params:
         stacked_keys += ("eplb_replica_slots", "eplb_replica_counts")
@@ -430,10 +445,21 @@ def forward_core(
     def body(carry, scanned):
         x, flat_cache = carry  # flat_cache: [L*P*ps, 2Hk, Dhp] slot view (in-place carry)
         lp, l = scanned  # per-layer params + layer index
+
+        def _mm(key, pattern, xin):
+            """Weight matmul, int8-aware: per-OUTPUT-channel scales commute
+            out of the dot (x @ (w*s) == (x @ w) * s), so the dot streams the
+            int8 tensor from HBM (XLA fuses the convert into the operand) and
+            the scale is one fused elementwise on the output."""
+            if key in lp:
+                return jnp.einsum(pattern, xin, lp[key])
+            y = jnp.einsum(pattern, xin, lp[key + "_q"].astype(xin.dtype))
+            return y * lp[key + "_scale"].astype(xin.dtype)
+
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = jnp.einsum("nd,dhk->nhk", h, lp["wq"])
-        k = jnp.einsum("nd,dhk->nhk", h, lp["wk"])
-        v = jnp.einsum("nd,dhk->nhk", h, lp["wv"])
+        q = _mm("wq", "nd,dhk->nhk", h)
+        k = _mm("wk", "nd,dhk->nhk", h)
+        v = _mm("wv", "nd,dhk->nhk", h)
         if cfg.attn_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         if has_lora:
@@ -465,7 +491,7 @@ def forward_core(
             chunk_k=pad_heads(k), chunk_v=pad_heads(v),
         )
         attn = attn[..., :Dh]
-        o = jnp.einsum("nhk,hkd->nd", attn, lp["wo"])
+        o = _mm("wo", "nhk,hkd->nd", attn)
         if cfg.attn_bias:
             o = o + lp["bo"]
         if has_lora:
@@ -490,7 +516,8 @@ def forward_core(
                 y = y + swiglu(h, lp["shared_wi"], lp["shared_wo"])
         else:
             cnt = jnp.zeros((0,), jnp.int32)
-            y = swiglu(h, lp["wi"], lp["wo_mlp"])
+            y = swiglu(h, None, None, mm=_mm) if "wi_q" in lp else swiglu(
+                h, lp["wi"], lp["wo_mlp"])
         x = x + y
         return (x, flat_cache), cnt
 
@@ -505,6 +532,10 @@ def forward_core(
 
 def unembed(cfg: ModelConfig, params: dict[str, jax.Array], hidden: jax.Array) -> jax.Array:
     """hidden [..., D] → logits [..., vocab] (fp32)."""
+    if "unembed_q" in params:  # weight-only int8 (models/quant.py)
+        logits = jnp.einsum("...d,dv->...v", hidden.astype(jnp.float32),
+                            params["unembed_q"].astype(jnp.float32))
+        return logits * params["unembed_scale"].astype(jnp.float32)
     w = params["embed"].T if cfg.tie_embeddings else params["unembed"]
     return jnp.einsum("...d,dv->...v", hidden.astype(jnp.float32), w.astype(jnp.float32))
 

@@ -1,0 +1,166 @@
+"""Weight-only int8 quantization (models/quant.py): the decode path is
+weights-bandwidth-bound, so halving weight bytes doubles the single-chip
+decode roofline — provided the quantized model still generates faithfully.
+These tests pin the scheme's error bound, the serving path end-to-end, the
+meshed sharding of quantized leaves, and both unembedding variants."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine import EngineConfig, LLMEngine
+from llmd_tpu.models import get_model_config
+from llmd_tpu.models.quant import quantize_params
+from llmd_tpu.models.transformer import (
+    init_params,
+    param_logical_axes,
+    unembed,
+)
+
+
+def _gen(eng, prompt, n=8):
+    eng.add_request("r", list(prompt),
+                    SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True))
+    out = []
+    while eng.has_work():
+        for o in eng.step():
+            out.extend(o.new_token_ids)
+    return out
+
+
+def test_quantize_params_shapes_and_error_bound():
+    cfg = get_model_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    w_ref = np.asarray(params["wq"], np.float32)
+    qp, axes = quantize_params(cfg, params)
+    assert "wq" not in qp and qp["wq_q"].dtype == jnp.int8
+    assert qp["wq_scale"].shape == w_ref.shape[:1] + w_ref.shape[2:]  # [L,H,K]
+    # per-output-channel symmetric: |w - q*s| <= s/2 = amax/254 per channel
+    deq = np.asarray(qp["wq_q"], np.float32) * np.asarray(qp["wq_scale"])[:, None]
+    amax = np.abs(w_ref).max(axis=1, keepdims=True)
+    assert np.all(np.abs(deq - w_ref) <= amax / 254 + 1e-7)
+    # axes dict matches the NEW tree exactly (shard_pytree tree-maps them)
+    assert set(axes) == set(qp)
+    assert axes["wq_scale"] == ("layers", "heads", "head_dim")
+    assert axes["wo_scale"] == ("layers", "embed")
+
+
+def test_quantized_logits_close_teacher_forced():
+    """Teacher-forced logits after quantization stay close to bf16 — the
+    robust metric: free-running greedy on a RANDOM-weight model diverges
+    permanently at the first near-tie flip, which measures the flatness of
+    random logits, not quantization quality (measured on the 1B random HF
+    checkpoint: cosine >= 0.996, |dlogit| ~6% of logit std)."""
+    from llmd_tpu.models.transformer import forward, init_cache
+
+    cfg = get_model_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    T = 32
+    toks = jnp.asarray([[(7 * i + 3) % (cfg.vocab_size - 2) + 1
+                         for i in range(T)]])
+    pos = jnp.arange(T)[None, :]
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    kv = jnp.full((1,), T, jnp.int32)
+
+    def logits_for(p):
+        out = forward(cfg, p, init_cache(cfg, 8, 8), toks, pos, pt, kv,
+                      with_hidden=True)
+        return np.asarray(unembed(cfg, p, out[-1]))[0]
+
+    ref = logits_for(params)
+    qp, _ = quantize_params(cfg, params)
+    got = logits_for(qp)
+    cos = np.sum(ref * got, -1) / (
+        np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1))
+    assert np.all(cos > 0.995), cos.min()
+    assert np.mean(np.argmax(ref, -1) == np.argmax(got, -1)) >= 0.8
+
+
+def test_quantized_engine_serves_end_to_end():
+    cfg = get_model_config("tiny")
+    eng_cfg = dict(page_size=8, num_pages=64, max_model_len=256,
+                   max_batch_size=4, prefill_chunk=32)
+    quant = LLMEngine(cfg, EngineConfig(**eng_cfg, quantize_weights="int8"),
+                      seed=0)
+    assert quant.quantization == "int8"
+    out_q = _gen(quant, list(range(7, 47)))
+    assert len(out_q) == 8
+    # determinism: the quantized program replays exactly
+    quant2 = LLMEngine(cfg, EngineConfig(**eng_cfg, quantize_weights="int8"),
+                       seed=0)
+    assert _gen(quant2, list(range(7, 47))) == out_q
+
+
+def test_quantized_unembed_both_tie_variants():
+    from dataclasses import replace
+
+    for tie in (True, False):
+        cfg = replace(get_model_config("tiny"), tie_embeddings=tie)
+        params = init_params(cfg, jax.random.PRNGKey(1))
+        h = jax.random.normal(jax.random.PRNGKey(2), (5, cfg.hidden_size),
+                              jnp.float32)
+        ref = np.asarray(unembed(cfg, params, h))
+        qp, _ = quantize_params(cfg, params)
+        assert "unembed_q" in qp and ("unembed" not in qp)
+        got = np.asarray(unembed(cfg, qp, h))
+        cos = np.sum(ref * got, -1) / (
+            np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1))
+        assert np.all(cos > 0.999), cos
+        assert np.mean(np.argmax(ref, -1) == np.argmax(got, -1)) >= 0.8
+
+
+def test_quantized_engine_on_tp_mesh():
+    """Quantized leaves shard like their bf16 ancestors (the axes dict the
+    quantizer returns) — the meshed engine builds and generates."""
+    from llmd_tpu.parallel.mesh import MeshConfig
+
+    cfg = get_model_config("tiny")
+    eng = LLMEngine(cfg, EngineConfig(
+        page_size=8, num_pages=64, max_model_len=256, max_batch_size=4,
+        prefill_chunk=32, mesh=MeshConfig(dp=1, sp=1, ep=1, tp=2),
+        quantize_weights="int8"))
+    out = _gen(eng, list(range(11, 41)), n=4)
+    assert len(out) == 4
+    assert eng.params["wq_q"].dtype == jnp.int8
+
+
+def test_unknown_quantization_rejected():
+    import pytest
+
+    cfg = get_model_config("tiny")
+    with pytest.raises(ValueError, match="quantize_weights"):
+        LLMEngine(cfg, EngineConfig(page_size=8, num_pages=32,
+                                    quantize_weights="fp4"))
+
+
+def test_quantized_weights_halve_decode_bytes():
+    """The point of the exercise: the per-step weight stream shrinks ~2x
+    (int8 tensors + f32 per-channel scales vs bf16)."""
+    cfg = get_model_config("tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def stream_bytes(tree, keys):
+        return sum(np.asarray(tree[k]).nbytes for k in keys if k in tree)
+
+    dense_keys = ("wq", "wk", "wv", "wo", "wi", "wo_mlp")
+    before = stream_bytes(params, dense_keys)
+    qp, _ = quantize_params(cfg, params)
+    after = stream_bytes(qp, tuple(k + "_q" for k in dense_keys)
+                         + tuple(k + "_scale" for k in dense_keys))
+    assert after < 0.6 * before, (before, after)
+
+
+def test_moe_quantization_rejected_loudly():
+    """MoE expert banks stay bf16 — attention-only quantization would be a
+    silent near-no-op while the flag promises halved decode traffic, so the
+    engine refuses rather than misleads."""
+    import pytest
+
+    cfg = get_model_config("tiny-moe")
+    with pytest.raises(ValueError, match="MoE"):
+        LLMEngine(cfg, EngineConfig(page_size=8, num_pages=32,
+                                    quantize_weights="int8"))
