@@ -174,14 +174,13 @@ class LLMEngine:
                 raise ValueError(
                     f"unknown quantize_weights={engine_cfg.quantize_weights!r}"
                     " (supported: 'int8')")
-            if model_cfg.is_moe:
-                # the expert banks dominate an MoE weight stream and stay
-                # bf16 (Pallas grouped-GEMM path) — quantizing only the
-                # attention projections would be a silent near-no-op while
-                # the operator believes decode traffic was halved
+            if model_cfg.is_moe and engine_cfg.eplb is not None:
+                # EPLB regathers expert weights into physical slots; that
+                # path is not quantization-aware yet — refuse loudly rather
+                # than serve slot weights whose scales were left behind
                 raise ValueError(
-                    "quantize_weights='int8' does not support MoE models yet"
-                    " (expert banks would stay bf16; benefit ~none)")
+                    "quantize_weights='int8' with EPLB is not supported yet"
+                    " (redundant-expert regather is not quantization-aware)")
             from llmd_tpu.models.quant import quantize_params
 
             # before sharding: the returned axes dict matches the new tree,
@@ -408,6 +407,18 @@ class LLMEngine:
         self.moe_fallback_reason: Optional[str] = None
         if not self.model_cfg.is_moe:
             self.moe_backend = "n/a (dense model)"
+            return None
+        if self.cfg.quantize_weights == "int8":
+            # int8 expert banks run the scaled-einsum path (moe_block);
+            # the Pallas grouped GEMM is bf16-only — an EXPLICIT pallas
+            # request conflicts and must fail loudly, like every other
+            # explicit-mode contract in backend selection
+            if self.cfg.moe_matmul == "pallas":
+                raise ValueError(
+                    "moe_matmul='pallas' (grouped GEMM, bf16-only) is "
+                    "incompatible with quantize_weights='int8'")
+            self.moe_backend = "xla_einsum (int8 weights)"
+            self.moe_fallback_reason = "int8 weights (grouped GEMM is bf16-only)"
             return None
         mode = self.cfg.moe_matmul
         if mode == "einsum":

@@ -15,12 +15,13 @@ operand stream — no dequantized copy is ever materialised in HBM) and the
 scale applies to the matmul OUTPUT, a [*, out] elementwise multiply that
 fuses into the surrounding graph.
 
-Quantized this round: the dense per-layer projections (wq/wk/wv/wo,
-wi/wo_mlp) and the unembedding — the whole weight stream of a dense decode
-step. Kept bf16: norms and biases (tiny), embed (gather table; also the
-tie_embeddings source), LoRA deltas (numerically delicate low-rank), MoE
-expert banks (the Pallas grouped-GEMM path is bf16; MoE quantization rides
-a later round).
+Quantized: the dense per-layer projections (wq/wk/wv/wo, wi/wo_mlp), the
+MoE expert banks and shared experts (per-expert per-output-channel scales;
+the expert GEMMs then run the einsum path — the Pallas grouped GEMM is
+bf16-only), and the unembedding. Kept bf16: norms, biases and the router
+(tiny), embed (gather table; also the tie_embeddings source), LoRA deltas
+(numerically delicate low-rank). EPLB's redundant-expert regather is not
+yet quantization-aware — the engine rejects that combination loudly.
 
 Cited reference behavior: quantized serving is table stakes in the
 reference's model servers (vLLM --quantization; fp8 checkpoints on GPU).
@@ -34,7 +35,8 @@ import jax
 import jax.numpy as jnp
 
 # key → axis NAMES contracted by its matmul (from param_logical_axes); the
-# scale lives on every remaining (output/batch) axis
+# scale lives on every remaining (output/batch) axis — for expert banks that
+# includes the experts axis, i.e. per-expert per-output-channel scales
 _CONTRACT: dict[str, tuple[str, ...]] = {
     "wq": ("embed",),
     "wk": ("embed",),
@@ -42,10 +44,15 @@ _CONTRACT: dict[str, tuple[str, ...]] = {
     "wo": ("heads", "head_dim"),
     "wi": ("embed",),
     "wo_mlp": ("mlp",),
+    "moe_wi": ("embed",),
+    "moe_wo": ("expert_mlp",),
+    "shared_wi": ("embed",),
+    "shared_wo": ("mlp",),
     "unembed": ("embed",),
 }
 
-QUANTIZABLE_LAYER_KEYS = ("wq", "wk", "wv", "wo", "wi", "wo_mlp")
+QUANTIZABLE_LAYER_KEYS = ("wq", "wk", "wv", "wo", "wi", "wo_mlp",
+                          "moe_wi", "moe_wo", "shared_wi", "shared_wo")
 
 
 def _quantize_one(w: jax.Array, contract_axes: tuple[int, ...]):

@@ -186,6 +186,8 @@ def moe_block(
     eplb: Optional[tuple[jax.Array, jax.Array]] = None,
     matmul_impl=None,
     token_mask: Optional[jax.Array] = None,
+    wi_scale: Optional[jax.Array] = None,
+    wo_scale: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Top-k routed MoE with capacity-based dispatch (XLA-friendly static shapes).
 
@@ -242,15 +244,22 @@ def moe_block(
         comb2 = comb.sum(1)
 
         xe = jnp.einsum("tec,td->ecd", disp2, x)  # all-to-all in, [S, C, D]
-        if matmul_impl is not None:
+        if matmul_impl is not None and wi_scale is None:
             slot_counts = jnp.sum(disp2, axis=(0, 2)).astype(jnp.int32)  # [S]
             gate_up = matmul_impl(xe, wi, slot_counts)
             gate, up = jnp.split(gate_up, 2, axis=-1)
             ye = matmul_impl(jax.nn.silu(gate) * up, wo, slot_counts)
         else:
-            gate_up = jnp.einsum("ecd,edf->ecf", xe, wi)
+            # int8 expert banks: per-expert per-output-channel scales commute
+            # out of the dot (see models/quant.py) — [S, 2F] / [S, D]
+            gate_up = jnp.einsum("ecd,edf->ecf", xe, wi.astype(x.dtype))
+            if wi_scale is not None:
+                gate_up = gate_up * wi_scale[:, None, :].astype(x.dtype)
             gate, up = jnp.split(gate_up, 2, axis=-1)
-            ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, wo)
+            ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up,
+                            wo.astype(x.dtype))
+            if wo_scale is not None:
+                ye = ye * wo_scale[:, None, :].astype(x.dtype)
         return jnp.einsum("tec,ecd->td", comb2, ye)  # all-to-all back
 
     if cfg.moe_dbo and T % 2 == 0 and T >= 2:
@@ -422,7 +431,8 @@ def forward_core(
     stacked_keys = ("attn_norm", "mlp_norm") + _variants("wq", "wk", "wv", "wo") + (
         ("q_norm", "k_norm") if cfg.qk_norm else ()
     ) + (("bq", "bk", "bv", "bo") if cfg.attn_bias else ()) + (
-        ("router", "moe_wi", "moe_wo") + (("shared_wi", "shared_wo") if cfg.moe_num_shared_experts else ())
+        ("router",) + _variants("moe_wi", "moe_wo")
+        + (_variants("shared_wi", "shared_wo") if cfg.moe_num_shared_experts else ())
         if cfg.is_moe
         else _variants("wi", "wo_mlp")
     )
@@ -507,13 +517,26 @@ def forward_core(
                 if "eplb_replica_slots" in lp
                 else None
             )
+            quant_moe = "moe_wi_q" in lp  # int8 expert banks: einsum path only
             y, cnt = moe_block(
-                cfg, h, lp["router"], lp["moe_wi"], lp["moe_wo"],
-                eplb=eplb, matmul_impl=moe_matmul_impl,
+                cfg, h, lp["router"],
+                lp["moe_wi_q" if quant_moe else "moe_wi"],
+                lp["moe_wo_q" if quant_moe else "moe_wo"],
+                eplb=eplb,
+                matmul_impl=None if quant_moe else moe_matmul_impl,
                 token_mask=(positions >= 0),
+                wi_scale=lp["moe_wi_scale"] if quant_moe else None,
+                wo_scale=lp["moe_wo_scale"] if quant_moe else None,
             )
             if cfg.moe_num_shared_experts:
-                y = y + swiglu(h, lp["shared_wi"], lp["shared_wo"])
+                if "shared_wi_q" in lp:
+                    def _shared_mm(key, pattern, xin):
+                        return _mm({"wi": "shared_wi",
+                                    "wo_mlp": "shared_wo"}[key], pattern, xin)
+
+                    y = y + swiglu(h, None, None, mm=_shared_mm)
+                else:
+                    y = y + swiglu(h, lp["shared_wi"], lp["shared_wo"])
         else:
             cnt = jnp.zeros((0,), jnp.int32)
             y = swiglu(h, None, None, mm=_mm) if "wi_q" in lp else swiglu(

@@ -154,13 +154,77 @@ def test_quantized_weights_halve_decode_bytes():
     assert after < 0.6 * before, (before, after)
 
 
-def test_moe_quantization_rejected_loudly():
-    """MoE expert banks stay bf16 — attention-only quantization would be a
-    silent near-no-op while the flag promises halved decode traffic, so the
-    engine refuses rather than misleads."""
+def test_moe_quantized_logits_close_and_serves():
+    """Expert banks quantize per-expert per-output-channel; teacher-forced
+    logits stay close and the quantized MoE engine serves (einsum path —
+    provenance says so)."""
+    from llmd_tpu.models.transformer import forward, init_cache
+
+    cfg = get_model_config("tiny-moe")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    qp, axes = quantize_params(cfg, params)
+    assert qp["moe_wi_q"].dtype == jnp.int8
+    assert axes["moe_wi_scale"] == ("layers", "experts", "expert_mlp")
+
+    T = 24
+    toks = jnp.asarray([[(5 * i + 2) % (cfg.vocab_size - 2) + 1
+                         for i in range(T)]])
+    pos = jnp.arange(T)[None, :]
+    pt = jnp.arange(8, dtype=jnp.int32)[None, :]
+    kv = jnp.full((1,), T, jnp.int32)
+
+    def logits_for(p):
+        out = forward(cfg, p, init_cache(cfg, 8, 8), toks, pos, pt, kv,
+                      with_hidden=True)
+        return np.asarray(unembed(cfg, p, out[-1]))[0]
+
+    ref, got = logits_for(params), logits_for(qp)
+    cos = np.sum(ref * got, -1) / (
+        np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1))
+    assert np.all(cos > 0.99), cos.min()
+
+    eng = LLMEngine(cfg, EngineConfig(page_size=8, num_pages=64,
+                                      max_model_len=256, max_batch_size=4,
+                                      prefill_chunk=32,
+                                      quantize_weights="int8"))
+    assert eng.moe_backend == "xla_einsum (int8 weights)"
+    assert len(_gen(eng, list(range(9, 33)), n=4)) == 4
+
+
+def test_moe_quantized_on_wide_ep_mesh():
+    """int8 expert banks under an ep=2 mesh: _q/_scale leaves shard by the
+    experts axis like their bf16 ancestors."""
+    from llmd_tpu.parallel.mesh import MeshConfig
+
+    cfg = get_model_config("tiny-moe")
+    eng = LLMEngine(cfg, EngineConfig(
+        page_size=8, num_pages=64, max_model_len=128, max_batch_size=4,
+        prefill_chunk=16, mesh=MeshConfig(dp=1, sp=1, ep=2, tp=1),
+        quantize_weights="int8"))
+    assert len(_gen(eng, list(range(9, 33)), n=4)) == 4
+
+
+def test_eplb_quantization_rejected_loudly():
+    """EPLB's redundant-expert regather is not quantization-aware yet —
+    refuse rather than serve slot weights whose scales were left behind."""
+    import pytest
+
+    from llmd_tpu.parallel.eplb import EPLBConfig
+
+    cfg = get_model_config("tiny-moe")
+    with pytest.raises(ValueError, match="EPLB"):
+        LLMEngine(cfg, EngineConfig(page_size=8, num_pages=32,
+                                    quantize_weights="int8",
+                                    eplb=EPLBConfig(num_redundant_experts=2)))
+
+
+def test_explicit_pallas_moe_conflicts_with_int8():
+    """moe_matmul='pallas' is an explicit kernel request; int8 can't honor it
+    (grouped GEMM is bf16-only) — fail loudly, never silently downgrade."""
     import pytest
 
     cfg = get_model_config("tiny-moe")
-    with pytest.raises(ValueError, match="MoE"):
+    with pytest.raises(ValueError, match="pallas"):
         LLMEngine(cfg, EngineConfig(page_size=8, num_pages=32,
-                                    quantize_weights="int8"))
+                                    quantize_weights="int8",
+                                    moe_matmul="pallas"))

@@ -1,0 +1,91 @@
+"""One-command on-chip perf campaign (r05): baseline vs int8 vs batch sweep
+vs long-context, each a fresh bench.py subprocess, all artifacts in one JSON.
+
+The fabric has been intermittent; this script is built to harvest whatever
+window it gets: every point is independent (a failure or a device drop mid-
+campaign keeps every completed point), bench.py's own preflight turns a dead
+fabric into a structured skip rather than a crash, and partial results are
+flushed to disk after every point.
+
+Usage: python tools/r05_campaign.py [--out BENCH_CAMPAIGN_r05.json]
+                                    [--skip baseline,int8,...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+POINTS: list[tuple[str, list[str]]] = [
+    ("baseline", []),                     # r04 defaults: NT=8192, k=32, b=32
+    ("int8", ["--quantize", "int8"]),
+    ("int8-b64", ["--quantize", "int8", "--batch", "64"]),
+    ("b64", ["--batch", "64"]),
+    ("b128", ["--batch", "128"]),
+    ("int8-b128", ["--quantize", "int8", "--batch", "128"]),
+    ("longctx-isl2048", ["--isl", "2048", "--osl", "128", "--batch", "16"]),
+    ("longctx-int8", ["--isl", "2048", "--osl", "128", "--batch", "16",
+                      "--quantize", "int8"]),
+]
+
+
+def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
+    cmd = [sys.executable, os.path.join(ROOT, "bench.py")] + extra
+    print(f"=== {name}: {' '.join(cmd)}", flush=True)
+    t0 = time.monotonic()
+    try:
+        p = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"point": name, "error": f"timeout {timeout_s:.0f}s"}
+    sys.stderr.write(p.stderr[-1500:] + "\n")
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            out = json.loads(line)
+            out["point"] = name
+            out["wall_total_s"] = round(time.monotonic() - t0, 1)
+            return out
+        except json.JSONDecodeError:
+            continue
+    return {"point": name, "error": f"no JSON (rc={p.returncode})",
+            "tail": (p.stderr or p.stdout)[-400:]}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="BENCH_CAMPAIGN_r05.json")
+    ap.add_argument("--skip", default="",
+                    help="comma-separated point names to skip")
+    ap.add_argument("--timeout", type=float, default=1500.0)
+    args = ap.parse_args()
+    skip = set(filter(None, args.skip.split(",")))
+    out_path = os.path.join(ROOT, args.out)
+
+    results: list[dict] = []
+    for name, extra in POINTS:
+        if name in skip:
+            continue
+        results.append(run_point(name, extra, args.timeout))
+        serving = [r for r in results
+                   if r.get("value") and not r["point"].startswith("longctx")]
+        best = max(serving, key=lambda r: r["value"]) if serving else None
+        with open(out_path, "w") as f:  # flush after EVERY point
+            json.dump({
+                "campaign": "r05",
+                "reference_r03": {"value": 1930.0, "weights_bw_util": 0.153},
+                "results": results,
+                "best_serving": ({"point": best["point"], "value": best["value"],
+                                  "weights_bw_util": best.get("weights_bw_util")}
+                                 if best else None),
+            }, f, indent=2)
+    print(json.dumps(json.load(open(out_path))["best_serving"] or {}))
+
+
+if __name__ == "__main__":
+    main()
