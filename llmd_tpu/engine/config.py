@@ -83,8 +83,10 @@ class EngineConfig:
     # "pallas" = force (interpret off-TPU), "einsum" = XLA dot path.
     moe_matmul: str = "auto"
     # Weight-only quantization (models/quant.py): "int8" halves decode's
-    # HBM weight traffic (per-output-channel symmetric; dense projections +
-    # unembedding; MoE expert banks stay bf16). None = serve checkpoint dtype.
+    # HBM weight traffic — per-output-channel symmetric on the dense
+    # projections, the unembedding, and the MoE expert banks (per-expert
+    # scales; expert GEMMs then run the scaled-einsum path, and EPLB
+    # regathers scales with their slots). None = serve checkpoint dtype.
     quantize_weights: "str | None" = None
     # Expert-parallel load balancing with redundant experts (wide-ep --enable-eplb
     # {window_size, step_interval, num_redundant_experts}); None = disabled.

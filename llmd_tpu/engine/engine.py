@@ -174,13 +174,6 @@ class LLMEngine:
                 raise ValueError(
                     f"unknown quantize_weights={engine_cfg.quantize_weights!r}"
                     " (supported: 'int8')")
-            if model_cfg.is_moe and engine_cfg.eplb is not None:
-                # EPLB regathers expert weights into physical slots; that
-                # path is not quantization-aware yet — refuse loudly rather
-                # than serve slot weights whose scales were left behind
-                raise ValueError(
-                    "quantize_weights='int8' with EPLB is not supported yet"
-                    " (redundant-expert regather is not quantization-aware)")
             from llmd_tpu.models.quant import quantize_params
 
             # before sharding: the returned axes dict matches the new tree,
@@ -468,7 +461,7 @@ class LLMEngine:
 
         mesh = self.mesh
 
-        def _gather(wi, wo, s2e):
+        def _gather(wi, wo, s2e, wi_s=None, wo_s=None):
             l = jnp.arange(wi.shape[0])[:, None]
             wi_p, wo_p = wi[l, s2e], wo[l, s2e]
             if mesh is not None:
@@ -478,7 +471,21 @@ class LLMEngine:
                     wi_p, NamedSharding(mesh, P(None, "ep", None, "tp")))
                 wo_p = jax.lax.with_sharding_constraint(
                     wo_p, NamedSharding(mesh, P(None, "ep", "tp", None)))
-            return wi_p, wo_p
+            if wi_s is None:
+                return wi_p, wo_p
+            # int8 expert banks: the per-expert scales regather by the SAME
+            # slot map — slot weights and their scales move together
+            wi_sp, wo_sp = wi_s[l, s2e], wo_s[l, s2e]
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                # scales shard with their weights' surviving axes: wi keeps
+                # its tp-sharded output channels, wo's outputs are unsharded
+                wi_sp = jax.lax.with_sharding_constraint(
+                    wi_sp, NamedSharding(mesh, P(None, "ep", "tp")))
+                wo_sp = jax.lax.with_sharding_constraint(
+                    wo_sp, NamedSharding(mesh, P(None, "ep", None)))
+            return wi_p, wo_p, wi_sp, wo_sp
 
         self._eplb_gather = jax.jit(_gather)
         self._eplb_rebalance()
@@ -492,10 +499,19 @@ class LLMEngine:
         if R < self._eplb_rmax:  # pad replica dim to its fixed max (no recompiles)
             pad = np.repeat(slots[:, :, :1], self._eplb_rmax - R, axis=2)
             slots = np.concatenate([slots, pad], axis=2)
-        wi_p, wo_p = self._eplb_gather(
-            self.params["moe_wi"], self.params["moe_wo"], jnp.asarray(s2e))
+        if "moe_wi_q" in self.params:  # int8 expert banks
+            wi_p, wo_p, wi_sp, wo_sp = self._eplb_gather(
+                self.params["moe_wi_q"], self.params["moe_wo_q"],
+                jnp.asarray(s2e), self.params["moe_wi_scale"],
+                self.params["moe_wo_scale"])
+            extra = {"moe_wi_q": wi_p, "moe_wo_q": wo_p,
+                     "moe_wi_scale": wi_sp, "moe_wo_scale": wo_sp}
+        else:
+            wi_p, wo_p = self._eplb_gather(
+                self.params["moe_wi"], self.params["moe_wo"], jnp.asarray(s2e))
+            extra = {"moe_wi": wi_p, "moe_wo": wo_p}
         self._eplb_params = {
-            "moe_wi": wi_p, "moe_wo": wo_p,
+            **extra,
             "eplb_replica_slots": jnp.asarray(slots),
             "eplb_replica_counts": jnp.asarray(counts),
         }

@@ -20,8 +20,9 @@ MoE expert banks and shared experts (per-expert per-output-channel scales;
 the expert GEMMs then run the einsum path — the Pallas grouped GEMM is
 bf16-only), and the unembedding. Kept bf16: norms, biases and the router
 (tiny), embed (gather table; also the tie_embeddings source), LoRA deltas
-(numerically delicate low-rank). EPLB's redundant-expert regather is not
-yet quantization-aware — the engine rejects that combination loudly.
+(numerically delicate low-rank). EPLB composes: the redundant-expert
+regather moves each slot's weights and its per-expert scales by the same
+slot map (engine._eplb_rebalance).
 
 Cited reference behavior: quantized serving is table stakes in the
 reference's model servers (vLLM --quantization; fp8 checkpoints on GPU).
@@ -48,7 +49,8 @@ _CONTRACT: dict[str, tuple[str, ...]] = {
     "moe_wo": ("expert_mlp",),
     "shared_wi": ("embed",),
     "shared_wo": ("mlp",),
-    "unembed": ("embed",),
+    # (the unembedding quantizes via its own branch below: its source can be
+    # embed.T under tie_embeddings, which has no entry in the axes dict)
 }
 
 QUANTIZABLE_LAYER_KEYS = ("wq", "wk", "wv", "wo", "wi", "wo_mlp",

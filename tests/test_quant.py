@@ -204,18 +204,31 @@ def test_moe_quantized_on_wide_ep_mesh():
     assert len(_gen(eng, list(range(9, 33)), n=4)) == 4
 
 
-def test_eplb_quantization_rejected_loudly():
-    """EPLB's redundant-expert regather is not quantization-aware yet —
-    refuse rather than serve slot weights whose scales were left behind."""
-    import pytest
-
+def test_eplb_regather_carries_scales():
+    """EPLB + int8: the redundant-expert regather moves each slot's weights
+    AND its per-expert scales by the same slot map — the wide-EP mesh engine
+    serves and rebalances without drift."""
     from llmd_tpu.parallel.eplb import EPLBConfig
+    from llmd_tpu.parallel.mesh import MeshConfig
 
     cfg = get_model_config("tiny-moe")
-    with pytest.raises(ValueError, match="EPLB"):
-        LLMEngine(cfg, EngineConfig(page_size=8, num_pages=32,
-                                    quantize_weights="int8",
-                                    eplb=EPLBConfig(num_redundant_experts=2)))
+    eng = LLMEngine(cfg, EngineConfig(
+        page_size=8, num_pages=64, max_model_len=128, max_batch_size=4,
+        prefill_chunk=16, mesh=MeshConfig(dp=1, sp=1, ep=2, tp=1),
+        quantize_weights="int8",
+        eplb=EPLBConfig(num_redundant_experts=2, window_size=8,
+                        step_interval=2)))
+    assert "moe_wi_q" in eng._eplb_params
+    assert eng._eplb_params["moe_wi_scale"].shape[1] == eng._eplb_slots
+    out = _gen(eng, list(range(9, 41)), n=6)
+    assert len(out) == 6
+    assert eng.stats.eplb_rebalances >= 1
+    # slot weights and scales regathered consistently: slot s serves expert
+    # s2e[s], so its scale row must equal that expert's logical scale row
+    s2e = eng._eplb_s2e
+    slot_scales = np.asarray(eng._eplb_params["moe_wi_scale"])
+    logical_scales = np.asarray(eng.params["moe_wi_scale"])
+    np.testing.assert_array_equal(slot_scales[0], logical_scales[0][s2e[0]])
 
 
 def test_explicit_pallas_moe_conflicts_with_int8():

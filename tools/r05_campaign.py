@@ -68,9 +68,11 @@ def main() -> None:
     out_path = os.path.join(ROOT, args.out)
 
     results: list[dict] = []
-    for name, extra in POINTS:
-        if name in skip:
-            continue
+    points = [(n, e) for n, e in POINTS if n not in skip]
+    if not points:
+        print(json.dumps({"error": "every point skipped"}))
+        return
+    for name, extra in points:
         results.append(run_point(name, extra, args.timeout))
         serving = [r for r in results
                    if r.get("value") and not r["point"].startswith("longctx")]
