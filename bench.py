@@ -104,9 +104,13 @@ def main() -> None:
     ap.add_argument("--decode-steps", type=int, default=None)
     ap.add_argument("--isl", type=int, default=None)
     ap.add_argument("--osl", type=int, default=None)
-    ap.add_argument("--quantize", default=None, choices=["int8"],
-                    help="weight-only quantization (halves decode's HBM "
-                         "weight traffic; models/quant.py)")
+    ap.add_argument("--quantize", default="default",
+                    choices=["int8", "none", "default"],
+                    help="weight-only quantization (models/quant.py). "
+                         "default: int8 on the standard serving run (decode "
+                         "is weights-BW-bound; the reference baselines serve "
+                         "fp8 — see PERF.md), off for --tiny; the bf16 "
+                         "fallback config is unaffected either way")
     args = ap.parse_args()
     tiny = args.tiny
     if args.cpu:
@@ -162,8 +166,11 @@ def main() -> None:
         eng_cfg.max_num_batched_tokens = max(eng_cfg.batched_tokens, args.batch * 8)
     if args.decode_steps:
         eng_cfg.decode_steps = args.decode_steps
-    if args.quantize:
-        eng_cfg.quantize_weights = args.quantize
+    if args.quantize == "default":
+        args.quantize = None if tiny else "int8"
+    elif args.quantize == "none":
+        args.quantize = None
+    eng_cfg.quantize_weights = args.quantize
     # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
     # the latency the pipelined decode path exists to hide
     import jax.numpy as jnp

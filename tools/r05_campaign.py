@@ -23,13 +23,14 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 POINTS: list[tuple[str, list[str]]] = [
-    ("baseline", []),                     # r04 defaults: NT=8192, k=32, b=32
+    ("baseline-bf16", ["--quantize", "none"]),  # r04 shape: NT=8192, k=32, b=32
     ("int8", ["--quantize", "int8"]),
     ("int8-b64", ["--quantize", "int8", "--batch", "64"]),
-    ("b64", ["--batch", "64"]),
-    ("b128", ["--batch", "128"]),
+    ("b64-bf16", ["--quantize", "none", "--batch", "64"]),
+    ("b128-bf16", ["--quantize", "none", "--batch", "128"]),
     ("int8-b128", ["--quantize", "int8", "--batch", "128"]),
-    ("longctx-isl2048", ["--isl", "2048", "--osl", "128", "--batch", "16"]),
+    ("longctx-isl2048", ["--isl", "2048", "--osl", "128", "--batch", "16",
+                         "--quantize", "none"]),
     ("longctx-int8", ["--isl", "2048", "--osl", "128", "--batch", "16",
                       "--quantize", "int8"]),
 ]
