@@ -8,7 +8,10 @@ fabric into a structured skip rather than a crash, and partial results are
 flushed to disk after every point.
 
 Usage: python tools/r05_campaign.py [--out BENCH_CAMPAIGN_r05.json]
-                                    [--skip baseline,int8,...]
+                                    [--skip baseline-bf16,int8,...]
+A re-run merges into an existing --out file: completed points are kept unless
+named for re-running (i.e. not skipped), so a fabric drop mid-campaign costs
+only the missed points.
 """
 
 from __future__ import annotations
@@ -23,8 +26,8 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 POINTS: list[tuple[str, list[str]]] = [
-    ("baseline-bf16", ["--quantize", "none"]),  # r04 shape: NT=8192, k=32, b=32
-    ("int8", ["--quantize", "int8"]),
+    ("baseline-bf16", ["--quantize", "none", "--batch", "32"]),  # r04 shape: NT=8192, k=32, b=32
+    ("int8", ["--quantize", "int8", "--batch", "32"]),
     ("int8-b64", ["--quantize", "int8", "--batch", "64"]),
     ("b64-bf16", ["--quantize", "none", "--batch", "64"]),
     ("b128-bf16", ["--quantize", "none", "--batch", "128"]),
@@ -66,9 +69,26 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=1500.0)
     args = ap.parse_args()
     skip = set(filter(None, args.skip.split(",")))
+    known = {n for n, _ in POINTS}
+    for s in skip - known:
+        print(f"# WARNING: --skip name {s!r} matches no point "
+              f"(known: {', '.join(sorted(known))})", file=sys.stderr)
     out_path = os.path.join(ROOT, args.out)
 
+    # a re-run (e.g. --skip of already-harvested points after a fabric drop)
+    # must MERGE with the existing artifact, not erase the harvested points
     results: list[dict] = []
+    if os.path.exists(out_path):
+        try:
+            prior = json.load(open(out_path)).get("results", [])
+        except (json.JSONDecodeError, OSError):
+            prior = []
+        rerun = {n for n, _ in POINTS if n not in skip}
+        results = [r for r in prior if r.get("point") not in rerun]
+        if results:
+            print(f"# merging {len(results)} prior point(s) from {args.out}",
+                  file=sys.stderr)
+
     points = [(n, e) for n, e in POINTS if n not in skip]
     if not points:
         print(json.dumps({"error": "every point skipped"}))
