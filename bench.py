@@ -330,7 +330,8 @@ def main() -> None:
         # the r04 defaults are more aggressive (single-step prefill, k=32);
         # a bench run must never die to a config experiment — fall back to the
         # r03-proven shape and measure that instead
-        if tiny or args.batch or args.decode_steps or quantize_explicit:
+        if (tiny or args.batch or args.decode_steps or args.isl or args.osl
+                or quantize_explicit):
             # an explicitly requested shape or quantization must not silently
             # re-measure as something else (e.g. bf16 under an "int8" label)
             raise

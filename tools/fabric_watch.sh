@@ -1,0 +1,55 @@
+#!/bin/bash
+# Poll the TPU fabric; the moment a window opens, harvest the campaign points
+# that missed the previous window (merge semantics keep completed points).
+# A "window" can close seconds after the probe succeeds, and the campaign
+# converts dead-fabric points into structured error rows with rc=0 — so
+# success is judged by whether the artifact GAINED a measured row, not by
+# exit codes. Keeps polling until it does (or MAX_POLLS is exhausted).
+MAX_POLLS=${MAX_POLLS:-200}
+SKIP=${SKIP:-baseline-bf16,int8,int8-b64,b64-bf16}
+ART=${ART:-BENCH_CAMPAIGN_r05.json}
+cd "$(dirname "$0")/.." || exit 1
+
+good_rows() {
+    python -c "
+import json, sys
+try:
+    rows = json.load(open('$ART')).get('results', [])
+except Exception:
+    rows = []
+print(sum(1 for r in rows if r.get('value')))"
+}
+
+profile_pass() {  # $1 = output file, remaining args passed through
+    local out="$1"; shift
+    local tmp; tmp=$(mktemp)
+    if timeout 1200 python tools/profile_decode.py --batch 64 --kvlen 320 "$@" \
+            >"$tmp" 2>&1 && grep -q "weights-probe" "$tmp"; then
+        mv "$tmp" "$out"   # only a completed pass may replace a prior artifact
+        echo "wrote $out"
+    else
+        echo "profile pass for $out failed; kept prior artifact (if any)"
+        tail -3 "$tmp"; rm -f "$tmp"
+    fi
+}
+
+for i in $(seq 1 "$MAX_POLLS"); do
+    if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+        echo "window open at poll $i ($(date -u +%H:%M:%S)); harvesting"
+        before=$(good_rows)
+        python tools/r05_campaign.py --skip "$SKIP"
+        after=$(good_rows)
+        if [ "$after" -gt "$before" ]; then
+            echo "harvest gained $((after - before)) measured row(s)"
+            profile_pass PROFILE_DECODE_r05.txt --quantize int8
+            profile_pass PROFILE_DECODE_bf16_r05.txt
+            exit 0
+        fi
+        echo "window closed before any point measured; resuming polls"
+    else
+        echo "poll $i: fabric down ($(date -u +%H:%M:%S))"
+    fi
+    sleep 120
+done
+echo "no window in $MAX_POLLS polls"
+exit 3

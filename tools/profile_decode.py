@@ -4,12 +4,16 @@ Builds the llama-1b decode program at bench shapes and times ablated variants:
   full        — forward + unembed + sample (what serving runs)
   no-sample   — forward + unembed + argmax feedback
   no-unembed  — forward only (constant token feedback)
-  weights-probe — einsums touching the big weights once (HBM roofline probe)
+  no-attn     — forward with the attention kernel replaced by identity
+                (isolates the paged-attention kernel + KV reads)
+  weights-probe — touch every big weight leaf once (HBM roofline probe)
 
 Differences between adjacent variants attribute per-step time to sampling,
-unembed, and the transformer body; the probe bounds achievable HBM bandwidth.
+unembed, attention, and the matmul body; the probe bounds achievable HBM
+bandwidth. --quantize int8 profiles the serving default's weight path.
 
-Usage: python tools/profile_decode.py [--batch 32] [--steps 16] [--kvlen 320]
+Usage: python tools/profile_decode.py [--batch 64] [--steps 16] [--kvlen 320]
+                                      [--quantize int8]
 """
 
 from __future__ import annotations
@@ -24,11 +28,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--steps", type=int, default=16)
     ap.add_argument("--kvlen", type=int, default=320)
     ap.add_argument("--model", default="llama-1b")
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--quantize", default="none", choices=["none", "int8"])
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
@@ -37,6 +42,11 @@ def main() -> None:
 
         xb._backend_factories.pop("axon", None)
     import jax
+
+    if args.cpu:
+        # sitecustomize captures jax_platforms before our env write lands;
+        # pin the config too (same recipe as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     from llmd_tpu.engine.sampling import sample_tokens
@@ -60,6 +70,10 @@ def main() -> None:
         attn = ragged_paged_attention_xla
 
     params = init_params(cfg, jax.random.PRNGKey(0))
+    if args.quantize == "int8":
+        from llmd_tpu.models.quant import quantize_params
+
+        params, _ = quantize_params(cfg, params)
     toks0 = jnp.ones((B,), jnp.int32)
     pos0 = jnp.full((B,), kvlen - 1, jnp.int32)
     # disjoint page tables per sequence (row-major page grid)
@@ -81,13 +95,21 @@ def main() -> None:
     tp = jnp.ones((B,), jnp.float32)
     key = jax.random.PRNGKey(1)
 
+    def null_attn(q, cache, pt, positions, seq_slots, kv_lens, *, cu_q_lens,
+                  num_seqs, scale, chunk_k=None, chunk_v=None):
+        # identity pass-through: keeps the dataflow (so XLA cannot fold the
+        # downstream wo matmul away) while skipping the kernel + KV reads
+        return q * scale
+
     def make_fn(mode):
+        attn_impl = null_attn if mode == "no-attn" else attn
+
         def step(params, carry, _):
             cache, toks, pos, lens = carry
             hidden, cache, _ = forward_core(
                 cfg, params, cache, toks, pos, seq_slots, pts, lens,
-                cu_q_lens=cu, num_seqs=ns, attn_impl=attn)
-            if mode == "no-unembed":
+                cu_q_lens=cu, num_seqs=ns, attn_impl=attn_impl)
+            if mode in ("no-unembed", "no-attn"):
                 nxt = toks
             else:
                 logits = unembed(cfg, params, hidden)
@@ -108,7 +130,7 @@ def main() -> None:
     print(f"# {args.model} B={B} k={k} kvlen={kvlen} "
           f"attn={'pallas' if on_tpu else 'xla'} on {jax.devices()[0].device_kind}")
     base = None
-    for mode in ["full", "no-sample", "no-unembed"]:
+    for mode in ["full", "no-sample", "no-unembed", "no-attn"]:
         fn = make_fn(mode)
         cache = init_cache(cfg, num_pages, ps)
         out, cache = fn(params, cache, toks0, pos0, lens0)  # compile
@@ -124,30 +146,29 @@ def main() -> None:
         print(f"{mode:12s}: {t*1e3:8.2f} ms/call  {t/k*1e3:6.2f} ms/step{delta}")
         del cache
 
-    # HBM roofline probe: decode-like einsums touching each big weight once
-    x = jnp.ones((B, cfg.hidden_size), cfg.jax_dtype)
+    # HBM roofline probe: touch every big weight leaf once per call. A traced
+    # scalar multiplies each leaf before the reduction so XLA cannot fold the
+    # reads away; dtype-agnostic, so it measures the int8 stream under
+    # --quantize int8 exactly as decode streams it.
+    big = {k: v for k, v in params.items() if v.size * v.dtype.itemsize > 1 << 20}
 
     @jax.jit
-    def wprobe(p, x):
-        q = jnp.einsum("bd,ldhk->blhk", x, p["wq"])
-        kk = jnp.einsum("bd,ldhk->blhk", x, p["wk"])
-        v = jnp.einsum("bd,ldhk->blhk", x, p["wv"])
-        o = jnp.einsum("blhk,lhkd->bd", q, p["wo"])
-        y = jnp.einsum("bd,ldf->blf", x, p["wi"])
-        z = jnp.einsum("blf,lfd->bd", y[..., : cfg.intermediate_size], p["wo_mlp"])
-        e = jnp.einsum("bd,vd->bv", x, p["embed"])
-        return (z + o).sum() + e.sum() + kk.sum() + v.sum()
+    def wprobe(p, s):
+        return sum(jnp.sum(v.astype(jnp.float32) * s) for v in p.values())
 
-    out = wprobe(params, x)
+    out = wprobe(big, jnp.float32(1.0))
     jax.block_until_ready(out)
     t0 = time.perf_counter()
-    for _ in range(args.reps):
-        out = wprobe(params, x)
+    for r in range(args.reps):
+        # every timed call gets fresh args: the tunneled runtime content-caches
+        # identical (executable, args) pairs, so a repeat of s=1.0 would time
+        # the cache, not the HBM reads
+        out = wprobe(big, jnp.float32(2.0 + r))
     jax.block_until_ready(out)
     t = (time.perf_counter() - t0) / args.reps
-    total = sum(int(v.size) for v in params.values())
-    gb = total * 2 / 1e9
-    print(f"weights-probe: {t*1e3:8.2f} ms for ~{gb:.2f} GB -> {gb/t:.0f} GB/s")
+    gb = sum(v.size * v.dtype.itemsize for v in big.values()) / 1e9
+    print(f"weights-probe: {t*1e3:8.2f} ms for {gb:.2f} GB -> {gb/t:.0f} GB/s "
+          f"({len(big)} leaves)")
 
 
 if __name__ == "__main__":

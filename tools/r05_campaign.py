@@ -76,33 +76,41 @@ def main() -> None:
     out_path = os.path.join(ROOT, args.out)
 
     # a re-run (e.g. --skip of already-harvested points after a fabric drop)
-    # must MERGE with the existing artifact, not erase the harvested points
-    results: list[dict] = []
+    # must MERGE with the existing artifact, not erase the harvested points.
+    # A prior entry survives until its replacement actually completes — a
+    # second fabric drop mid-re-run must not cost points it never re-reached.
+    prior: list[dict] = []
     if os.path.exists(out_path):
         try:
             prior = json.load(open(out_path)).get("results", [])
         except (json.JSONDecodeError, OSError):
             prior = []
-        rerun = {n for n, _ in POINTS if n not in skip}
-        results = [r for r in prior if r.get("point") not in rerun]
-        if results:
-            print(f"# merging {len(results)} prior point(s) from {args.out}",
+        if prior:
+            print(f"# merging into {len(prior)} prior point(s) from {args.out}",
                   file=sys.stderr)
 
     points = [(n, e) for n, e in POINTS if n not in skip]
     if not points:
         print(json.dumps({"error": "every point skipped"}))
         return
+    results: list[dict] = []
     for name, extra in points:
         results.append(run_point(name, extra, args.timeout))
-        serving = [r for r in results
+        prior_good = {r["point"] for r in prior if r.get("value")}
+        # a completed re-run supersedes its prior entry; a FAILED re-run must
+        # not replace a prior real measurement with an error row
+        keep_new = [r for r in results
+                    if r.get("value") or r.get("point") not in prior_good]
+        done = {r.get("point") for r in keep_new}
+        merged = [r for r in prior if r.get("point") not in done] + keep_new
+        serving = [r for r in merged
                    if r.get("value") and not r["point"].startswith("longctx")]
         best = max(serving, key=lambda r: r["value"]) if serving else None
         with open(out_path, "w") as f:  # flush after EVERY point
             json.dump({
                 "campaign": "r05",
                 "reference_r03": {"value": 1930.0, "weights_bw_util": 0.153},
-                "results": results,
+                "results": merged,
                 "best_serving": ({"point": best["point"], "value": best["value"],
                                   "weights_bw_util": best.get("weights_bw_util")}
                                  if best else None),
