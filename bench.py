@@ -106,6 +106,10 @@ def main() -> None:
     ap.add_argument("--decode-steps", type=int, default=None)
     ap.add_argument("--isl", type=int, default=None)
     ap.add_argument("--osl", type=int, default=None)
+    ap.add_argument("--layer-unroll", type=int, default=None,
+                    help="unroll the transformer layer scan N-wide "
+                         "(LLMD_LAYER_UNROLL; lets XLA overlap next-layer "
+                         "weight streams with compute)")
     ap.add_argument("--quantize", default="default",
                     choices=["int8", "none", "default"],
                     help="weight-only quantization (models/quant.py). "
@@ -170,6 +174,8 @@ def main() -> None:
         eng_cfg.max_num_batched_tokens = max(eng_cfg.batched_tokens, args.batch * 8)
     if args.decode_steps:
         eng_cfg.decode_steps = args.decode_steps
+    if args.layer_unroll:
+        os.environ["LLMD_LAYER_UNROLL"] = str(args.layer_unroll)
     quantize_explicit = args.quantize != "default"
     if args.quantize == "default":
         args.quantize = None if tiny else "int8"
@@ -191,6 +197,11 @@ def main() -> None:
 
     t0 = time.monotonic()
     cfg, params = resolve_model(model)
+    from llmd_tpu.models.transformer import layer_unroll as _layer_unroll_fn
+
+    # same parse + clamp as the trace site, so the artifact records exactly
+    # the unroll width that ran (env is the source of truth; the flag sets it)
+    _layer_unroll_prov = _layer_unroll_fn(cfg.num_layers)
     weights_src = f"hf:{model}" if params is not None else f"random:{model}"
     load_s = time.monotonic() - t0
     print(f"# weights {weights_src} (loaded in {load_s:.1f}s)", file=sys.stderr)
@@ -331,7 +342,7 @@ def main() -> None:
         # a bench run must never die to a config experiment — fall back to the
         # r03-proven shape and measure that instead
         if (tiny or args.batch or args.decode_steps or args.isl or args.osl
-                or quantize_explicit):
+                or args.layer_unroll or quantize_explicit):
             # an explicitly requested shape or quantization must not silently
             # re-measure as something else (e.g. bf16 under an "int8" label)
             raise
@@ -424,6 +435,7 @@ def main() -> None:
         "host_pack_us_per_call": round(pack_us_per_call, 1),
         "host_device_rtt_ms": round(rtt_ms, 1),
         "pipeline_decode": eng_cfg.pipeline_decode,
+        "layer_unroll": _layer_unroll_prov,
         "batch": eng_cfg.max_batch_size,
         "decode_steps_fused": eng_cfg.decode_steps,
         "isl": isl,

@@ -20,6 +20,20 @@ except Exception:
 print(sum(1 for r in rows if r.get('value')))"
 }
 
+missing_points() {  # non-skipped campaign points without a measured row
+    SKIP="$SKIP" ART="$ART" python -c "
+import json, os, sys
+sys.path.insert(0, 'tools')
+from r05_campaign import POINTS
+skip = set(filter(None, os.environ['SKIP'].split(',')))
+try:
+    rows = json.load(open(os.environ['ART'])).get('results', [])
+except Exception:
+    rows = []
+good = {r['point'] for r in rows if r.get('value')}
+print(','.join(n for n, _ in POINTS if n not in skip and n not in good))"
+}
+
 profile_pass() {  # $1 = output file, remaining args passed through
     local out="$1"; shift
     local tmp; tmp=$(mktemp)
@@ -37,15 +51,32 @@ for i in $(seq 1 "$MAX_POLLS"); do
     if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         echo "window open at poll $i ($(date -u +%H:%M:%S)); harvesting"
         before=$(good_rows)
-        python tools/r05_campaign.py --skip "$SKIP"
+        # only re-run what is still missing: a re-opened window must not burn
+        # time re-measuring points a previous window already harvested
+        still=$(missing_points)
+        if [ -z "$still" ]; then
+            echo "all non-skipped points already measured"
+        else
+            extra_skip=$(SKIP="$SKIP" python -c "
+import os, sys
+sys.path.insert(0, 'tools')
+from r05_campaign import POINTS
+still = set('$still'.split(','))
+print(','.join(n for n, _ in POINTS if n not in still))")
+            python tools/r05_campaign.py --skip "$extra_skip"
+        fi
         after=$(good_rows)
-        if [ "$after" -gt "$before" ]; then
-            echo "harvest gained $((after - before)) measured row(s)"
-            profile_pass PROFILE_DECODE_r05.txt --quantize int8
-            profile_pass PROFILE_DECODE_bf16_r05.txt
+        [ "$after" -gt "$before" ] && echo "harvest gained $((after - before)) measured row(s)"
+        # attribution passes are opportunistic: attempt once per window until
+        # each exists (profile_pass only replaces an artifact on success)
+        [ -f PROFILE_DECODE_r05.txt ] || profile_pass PROFILE_DECODE_r05.txt --quantize int8
+        [ -f PROFILE_DECODE_bf16_r05.txt ] || profile_pass PROFILE_DECODE_bf16_r05.txt
+        if [ -z "$(missing_points)" ] && [ -f PROFILE_DECODE_r05.txt ] \
+                && [ -f PROFILE_DECODE_bf16_r05.txt ]; then
+            echo "every non-skipped point measured and attribution captured"
             exit 0
         fi
-        echo "window closed before any point measured; resuming polls"
+        echo "still missing: [$(missing_points)]; resuming polls"
     else
         echo "poll $i: fabric down ($(date -u +%H:%M:%S))"
     fi

@@ -36,6 +36,12 @@ POINTS: list[tuple[str, list[str]]] = [
                          "--quantize", "none"]),
     ("longctx-int8", ["--isl", "2048", "--osl", "128", "--batch", "16",
                       "--quantize", "int8"]),
+    # layer-scan unroll A/B at the serving default (int8, b64): can XLA hide
+    # part of the weight stream behind compute across layer boundaries?
+    ("int8-b64-unroll4", ["--quantize", "int8", "--batch", "64",
+                          "--layer-unroll", "4"]),
+    ("int8-b64-unroll16", ["--quantize", "int8", "--batch", "64",
+                           "--layer-unroll", "16"]),
 ]
 
 
