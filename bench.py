@@ -153,7 +153,7 @@ def main() -> None:
         model, n_req, isl, osl = "llama-1b", 64, 256, 128
         # Batch 64: decode is weights-BW-bound, so per-step time barely grows
         # with batch while tokens/step doubles — measured on-chip r05:
-        # int8 b32 2,872 tok/s vs int8 b64 3,419 tok/s (BENCH_CAMPAIGN_r05.json).
+        # int8 b32 2,872 tok/s vs int8 b64 3,419 tok/s (BENCH_CAMPAIGN_r05_preclamp.json).
         # NT=8192 prefills the batch in two unified steps (one host round trip
         # each; ~67 ms tunnel RTT per call). decode_steps=32 halves fused-call
         # count for the same reason. bench falls back to the r03-proven config

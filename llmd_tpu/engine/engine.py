@@ -1111,6 +1111,25 @@ class LLMEngine:
         q = self._pending_decode
         off = sum(rec["k"] for rec in q)
 
+        # The host knows every row's HARD budget (max_tokens / max_model_len)
+        # without any device read: if the steps already in flight cover it for
+        # every row, one more speculative call would run k scan steps of
+        # fully-masked compute — measured as 2 wasted calls (64 of 192
+        # step-slots) per request wave at OSL 128 / k=32. Drain the oldest
+        # call instead; its results change membership and the normal flush
+        # path takes over. Checked BEFORE _ensure_pages so a provably-useless
+        # call cannot demand pages (or degrade to a unified step) either.
+        # (EOS-before-budget still speculates — that is the pipeline's
+        # purpose; this clamp only removes provably-useless calls.)
+        if q:
+            horizon = max(
+                min(s.max_tokens - (len(s.token_ids) + off - s.prompt_len),
+                    self.cfg.max_model_len - (len(s.token_ids) + off))
+                for s in active)
+            if horizon <= 0:
+                self._decode_process(q.pop(0))
+                return
+
         # A k-step scan writes KV for positions len-1 .. len+off+k-2 → needs
         # len+off+k-1 slots. If the pool can't cover the horizon, flush and
         # degrade to a single unified step (decode rows only) rather than

@@ -165,3 +165,25 @@ def test_no_orphaned_inflight_calls_on_membership_change():
     assert got == {"r0": 6, "r1": 14, "r2": 26}
     assert eng.stats.n_decode_dispatches == eng.stats.n_decode_calls
     assert not eng._pending_decode
+
+
+def test_no_dispatch_past_hard_budget():
+    """The host must not speculatively dispatch a fused call whose every step
+    is provably past all rows' max_tokens/max_model_len budget.
+
+    A UNIFORM wave (equal prompt lengths, one prefill batch, one shared
+    max_tokens) is the case that exposes it: membership never changes, so
+    before the horizon clamp the chain kept dispatching pipeline_depth extra
+    fully-masked calls past the budget — measured 6 dispatches where 4 carry
+    all the tokens (and the bench artifact's 6 calls for 127 steps at k=32).
+    Outputs must be unchanged vs the unpipelined engine."""
+    uniform = [[(7 * i + j) % 200 + 1 for j in range(32)] for i in range(4)]
+    sp = SamplingParams(max_tokens=17, temperature=0.0, ignore_eos=True)
+    kw = dict(prefill_chunk=64, max_num_batched_tokens=256, num_pages=256)
+    out_on, eng_on = _run(uniform, sp, True, **kw)
+    out_off, _ = _run(uniform, sp, False, **kw)
+    assert out_on == out_off
+    assert all(len(v) == 17 for v in out_on.values())
+    # prefill yields token 1; 16 more tokens = exactly ceil(16/4) fused calls
+    assert eng_on.stats.n_decode_dispatches == 4, eng_on.stats.n_decode_dispatches
+    assert eng_on.stats.n_decode_dispatches == eng_on.stats.n_decode_calls

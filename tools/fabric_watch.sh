@@ -6,7 +6,9 @@
 # success is judged by whether the artifact GAINED a measured row, not by
 # exit codes. Keeps polling until it does (or MAX_POLLS is exhausted).
 MAX_POLLS=${MAX_POLLS:-200}
-SKIP=${SKIP:-baseline-bf16,int8,int8-b64,b64-bf16}
+# default: skip nothing — every point re-measures after the horizon-clamp
+# dispatch fix made the pre-clamp rows stale (kept in *_preclamp.json)
+SKIP=${SKIP:-}
 ART=${ART:-BENCH_CAMPAIGN_r05.json}
 cd "$(dirname "$0")/.." || exit 1
 
