@@ -66,6 +66,10 @@ class EngineStats:
     kv_utilization: float = 0.0
     total_prefill_tokens: int = 0
     total_decode_tokens: int = 0
+    # tokens produced by FUSED decode calls only (excludes the unified-step
+    # degrade path, whose wall time lands in time_prefill_steps) — the only
+    # numerator that matches time_decode_steps as a denominator
+    decode_tokens_fused: int = 0
     total_preemptions: int = 0
     total_offload_loads: int = 0  # blocks pulled back from CPU/FS tiers
     eplb_rebalances: int = 0  # wide-EP expert-placement recomputes
@@ -1256,6 +1260,7 @@ class LLMEngine:
                 s.first_token_time = now
             s.maybe_commit_blocks(self.allocs[s.rank])
             self.stats.total_decode_tokens += len(kept)
+            self.stats.decode_tokens_fused += len(kept)
             if finished:
                 self._retire(s, reason)
             self._outputs.append(EngineOutput(

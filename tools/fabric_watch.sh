@@ -39,7 +39,8 @@ print(','.join(n for n, _ in POINTS if n not in skip and n not in good))"
 profile_pass() {  # $1 = output file, remaining args passed through
     local out="$1"; shift
     local tmp; tmp=$(mktemp)
-    if timeout 1200 python tools/profile_decode.py --batch 64 --kvlen 320 "$@" \
+    if timeout 1200 python tools/profile_decode.py --batch 64 --kvlen 320 \
+            --prefill 8192 "$@" \
             >"$tmp" 2>&1 && grep -q "weights-probe" "$tmp"; then
         mv "$tmp" "$out"   # only a completed pass may replace a prior artifact
         echo "wrote $out"

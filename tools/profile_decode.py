@@ -34,6 +34,9 @@ def main() -> None:
     ap.add_argument("--model", default="llama-1b")
     ap.add_argument("--reps", type=int, default=5)
     ap.add_argument("--quantize", default="none", choices=["none", "int8"])
+    ap.add_argument("--prefill", type=int, default=None, metavar="NT",
+                    help="also time a packed prefill chunk of NT tokens "
+                         "(B sequences x NT/B) with and without attention")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
@@ -145,6 +148,48 @@ def main() -> None:
             base = t
         print(f"{mode:12s}: {t*1e3:8.2f} ms/call  {t/k*1e3:6.2f} ms/step{delta}")
         del cache
+
+    # Prefill attribution: one packed chunk of B sequences x (NT/B) tokens
+    # through forward_core (+ last-row unembed, mirroring the engine's unified
+    # step), vs the MXU roofline 2*params*NT. The bench shows prefill at ~18%
+    # MFU — this pins whether the loss is the model program or engine overhead,
+    # and the no-attn variant splits out the ragged-attention share.
+    if args.prefill:
+        NT = args.prefill
+        T = max(1, NT // B)
+        assert T <= kvlen + k, (
+            f"--prefill {NT} needs {T} tokens/seq but the page tables cover "
+            f"kvlen+k={kvlen + k}; raise --kvlen")
+        toks_p = jnp.ones((B * T,), jnp.int32)
+        pos_p = jnp.tile(jnp.arange(T, dtype=jnp.int32), B)
+        slots_p = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)
+        lens_p = jnp.full((B,), T, jnp.int32)
+        cu_p = jnp.arange(B + 1, dtype=jnp.int32) * T
+        n_params = sum(int(v.size) for kk, v in params.items()
+                       if not kk.endswith("_scale"))
+        for mode in ["prefill", "prefill-no-attn"]:
+            impl = null_attn if mode == "prefill-no-attn" else attn
+
+            def pf(params, cache, toks):
+                hidden, cache, _ = forward_core(
+                    cfg, params, cache, toks, pos_p, slots_p, pts, lens_p,
+                    cu_q_lens=cu_p, num_seqs=ns, attn_impl=impl)
+                last = hidden[cu_p[1:] - 1]
+                return jnp.argmax(unembed(cfg, params, last), -1), cache
+
+            jpf = jax.jit(pf, donate_argnums=(1,))
+            cache = init_cache(cfg, num_pages, ps)
+            out, cache = jpf(params, cache, toks_p)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for r in range(args.reps):
+                out, cache = jpf(params, cache, toks_p + r + 1)
+            jax.block_until_ready(out)
+            t = (time.perf_counter() - t0) / args.reps
+            tf = 2 * n_params * B * T / 1e12
+            print(f"{mode:16s}: {t*1e3:8.2f} ms for NT={B*T} "
+                  f"-> {B*T/t:,.0f} tok/s, {tf/t:.1f} TF/s")
+            del cache
 
     # HBM roofline probe: touch every big weight leaf once per call. A traced
     # scalar multiplies each leaf before the reduction so XLA cannot fold the
