@@ -123,6 +123,12 @@ def main() -> None:
                          "is weights-BW-bound; the reference baselines serve "
                          "fp8 — see PERF.md), off for --tiny; the bf16 "
                          "fallback config is unaffected either way")
+    ap.add_argument("--kv-dtype", default="default",
+                    choices=["fp8", "bf16", "default"],
+                    help="KV-cache pool dtype (EngineConfig.kv_cache_dtype): "
+                         "fp8 halves decode's per-step KV read stream — the "
+                         "second HBM stream after weights at serving batch. "
+                         "default: bf16 until fp8 is validated on-chip")
     args = ap.parse_args()
     tiny = args.tiny
     if args.cpu:
@@ -188,6 +194,8 @@ def main() -> None:
     elif args.quantize == "none":
         args.quantize = None
     eng_cfg.quantize_weights = args.quantize
+    kv_explicit = args.kv_dtype != "default"
+    eng_cfg.kv_cache_dtype = "fp8" if args.kv_dtype == "fp8" else None
     # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
     # the latency the pipelined decode path exists to hide
     import jax.numpy as jnp
@@ -348,7 +356,7 @@ def main() -> None:
         # a bench run must never die to a config experiment — fall back to the
         # r03-proven shape and measure that instead
         if (tiny or args.batch or args.decode_steps or args.isl or args.osl
-                or args.layer_unroll or quantize_explicit):
+                or args.layer_unroll or quantize_explicit or kv_explicit):
             # an explicitly requested shape or quantization must not silently
             # re-measure as something else (e.g. bf16 under an "int8" label)
             raise
@@ -424,6 +432,7 @@ def main() -> None:
         "vs_baseline": round(tput / B200_ANCHOR_TOK_S, 4),
         "weights": weights_src,
         "quantize": eng_cfg.quantize_weights,
+        "kv_cache_dtype": eng.stats.kv_cache_dtype,
         "attn_backend": eng.attn_backend,
         "attn_fallback_reason": eng.attn_fallback_reason,
         "moe_backend": eng.moe_backend,

@@ -72,8 +72,13 @@ def insert_blocks(cache, page_ids: list[int], blocks: np.ndarray,
     L = cache.shape[0] // P
     pids = np.asarray(page_ids, np.int32)
     rows = np.arange(L)[:, None] * P + pids[None, :]  # [L, n]
-    dev = jnp.asarray(np.moveaxis(blocks, 0, 1)).astype(cache.dtype)
-    return cache.at[jnp.asarray(rows)].set(dev)
+    dev = jnp.asarray(np.moveaxis(blocks, 0, 1))
+    if cache.dtype == jnp.float8_e4m3fn and dev.dtype != cache.dtype:
+        # heterogeneous P/D pair (peer shipped wider KV): e4m3 has no inf, so
+        # a bare convert turns |v| > 448 into nan and poisons the page — clamp
+        # exactly like the engine's own write path (transformer.write_kv)
+        dev = jnp.clip(dev.astype(jnp.float32), -448.0, 448.0)
+    return cache.at[jnp.asarray(rows)].set(dev.astype(cache.dtype))
 
 
 # ---------------------------------------------------------------------------

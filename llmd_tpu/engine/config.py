@@ -88,6 +88,12 @@ class EngineConfig:
     # scales; expert GEMMs then run the scaled-einsum path, and EPLB
     # regathers scales with their slots). None = serve checkpoint dtype.
     quantize_weights: "str | None" = None
+    # KV-cache dtype: "fp8" stores pages as float8_e4m3fn — decode's OTHER
+    # HBM stream (per-step KV reads rival the weight bytes at serving batch
+    # sizes; at b=64/ctx 320 the bf16 KV read is ~1.3 GB/step on llama-1b).
+    # The Pallas kernel dequantizes in VMEM after the page DMA (k_scale/
+    # v_scale), so HBM traffic halves end to end. None = model dtype.
+    kv_cache_dtype: "str | None" = None
     # Expert-parallel load balancing with redundant experts (wide-ep --enable-eplb
     # {window_size, step_interval, num_redundant_experts}); None = disabled.
     eplb: Optional[EPLBConfig] = None

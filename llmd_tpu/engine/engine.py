@@ -75,6 +75,7 @@ class EngineStats:
     eplb_rebalances: int = 0  # wide-EP expert-placement recomputes
     attn_backend: str = ""  # kernel provenance (bench/debug)
     moe_backend: str = ""
+    kv_cache_dtype: str = ""  # "bf16" | "fp8" — pool dtype provenance
     sp_attn_backend: Optional[str] = None  # ring layout when sp>1 wired in
     n_ring_prefill_steps: int = 0  # unified steps served by the ring program
     # Per-phase wall-time attribution (bench.py breakdown — every serving-perf
@@ -190,7 +191,14 @@ class LLMEngine:
 
             params = shard_pytree(params, self.mesh, param_axes)
         self.params = params
-        self.cache = init_cache(model_cfg, engine_cfg.num_pages, engine_cfg.page_size)
+        if engine_cfg.kv_cache_dtype not in (None, "fp8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype={engine_cfg.kv_cache_dtype!r}"
+                " (supported: 'fp8')")
+        self.kv_dtype = (jnp.float8_e4m3fn if engine_cfg.kv_cache_dtype == "fp8"
+                         else model_cfg.jax_dtype)
+        self.cache = init_cache(model_cfg, engine_cfg.num_pages,
+                                engine_cfg.page_size, dtype=self.kv_dtype)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -231,6 +239,8 @@ class LLMEngine:
         moe_impl = self._select_moe_impl()
         self.stats.attn_backend = self.attn_backend
         self.stats.moe_backend = self.moe_backend
+        self.stats.kv_cache_dtype = ("fp8" if self.kv_dtype == jnp.float8_e4m3fn
+                                     else str(jnp.dtype(self.kv_dtype).name))
         use_lora = self.lora_registry is not None
         lora_scale = engine_cfg.lora.scale if use_lora else 1.0
         NT = self.cfg.batched_tokens
@@ -381,7 +391,9 @@ class LLMEngine:
             dhp = padded_head_dim(c.head_dim)
             ps = self.cfg.page_size
             q = jnp.zeros((1, c.num_heads, dhp), c.jax_dtype)
-            cache = jnp.zeros((2, ps, 2 * c.num_kv_heads, dhp), c.jax_dtype)
+            # smoke at the SERVING cache dtype — an fp8 strided-load failure
+            # must surface here (and fall back) rather than strand serving
+            cache = jnp.zeros((2, ps, 2 * c.num_kv_heads, dhp), self.kv_dtype)
             paged_attention_tpu(
                 q, cache, jnp.zeros((1, 2), jnp.int32), jnp.zeros((1,), jnp.int32),
                 jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),

@@ -45,6 +45,9 @@ def main() -> None:
     ap.add_argument("--quantize", default=None, choices=["int8"],
                     help="weight-only quantization: halves decode's HBM "
                          "weight traffic (models/quant.py)")
+    ap.add_argument("--kv-cache-dtype", default=None, choices=["fp8"],
+                    help="fp8 KV pool: halves decode's per-step KV read "
+                         "stream (the vLLM --kv-cache-dtype role)")
     ap.add_argument("--cpu-offload-pages", type=int, default=0,
                     help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
     ap.add_argument("--offload-fs-path", default=None,
@@ -91,6 +94,7 @@ def main() -> None:
         mesh=MeshConfig(dp=args.dp, sp=args.sp, ep=args.ep, tp=args.tp),
         dp_ranks=args.dp,
         quantize_weights=args.quantize,
+        kv_cache_dtype=args.kv_cache_dtype,
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig
@@ -139,8 +143,12 @@ def main() -> None:
 
     async def run() -> None:
         await server.start()
+        prov = ""
+        if args.quantize or args.kv_cache_dtype:
+            prov = (f" [weights={args.quantize or 'ckpt-dtype'}, "
+                    f"kv={args.kv_cache_dtype or 'ckpt-dtype'}]")
         print(f"llmd-tpu engine serving {server.model_name} on http://{server.address} "
-              f"(kv-events port {server.kv_events_port})", flush=True)
+              f"(kv-events port {server.kv_events_port}){prov}", flush=True)
         await asyncio.Event().wait()
 
     asyncio.run(run())

@@ -297,14 +297,26 @@ def moe_block(
 # ---------------------------------------------------------------------------
 
 
-def init_cache(cfg: ModelConfig, num_pages: int, page_size: int) -> jax.Array:
+def init_cache(cfg: ModelConfig, num_pages: int, page_size: int,
+               dtype=None) -> jax.Array:
     """[L*P, page_size, 2*Hk, Dhp] flat pool: layer l's page p at row l*P + p;
-    K at combined head 2h, V at 2h+1."""
+    K at combined head 2h, V at 2h+1.
+
+    ``dtype`` overrides the model dtype for the pool — float8_e4m3fn halves
+    decode's KV read stream (EngineConfig.kv_cache_dtype="fp8"); the Pallas
+    kernel dequantizes pages in VMEM and the XLA fallback upcasts at use.
+    """
     return jnp.zeros(
         (cfg.num_layers * num_pages, page_size, 2 * cfg.num_kv_heads,
          padded_head_dim(cfg.head_dim)),
-        cfg.jax_dtype,
+        dtype if dtype is not None else cfg.jax_dtype,
     )
+
+
+# float8_e4m3fn has no inf: values past ±448 convert to nan, so fp8 cache
+# writes clamp first. K/V activations live at O(1)–O(10); the clamp is a
+# no-op in practice and fuses into the write's convert.
+_FP8_MAX = 448.0
 
 
 def write_kv(flat_cache: jax.Array, k: jax.Array, v: jax.Array, slots: jax.Array) -> jax.Array:
@@ -318,7 +330,10 @@ def write_kv(flat_cache: jax.Array, k: jax.Array, v: jax.Array, slots: jax.Array
     S, HkC, Dhp = flat_cache.shape
     idx = jnp.where(slots >= 0, slots, S)
     # interleave K/V per head: [N, Hk, 2, Dhp] → [N, 2*Hk, Dhp], K even / V odd
-    kv = jnp.stack([k, v], axis=2).reshape(k.shape[0], HkC, Dhp).astype(flat_cache.dtype)
+    kv = jnp.stack([k, v], axis=2).reshape(k.shape[0], HkC, Dhp)
+    if flat_cache.dtype == jnp.float8_e4m3fn:
+        kv = jnp.clip(kv.astype(jnp.float32), -_FP8_MAX, _FP8_MAX)
+    kv = kv.astype(flat_cache.dtype)
     return flat_cache.at[idx].set(kv, mode="drop")
 
 
@@ -365,6 +380,10 @@ def ragged_paged_attention_xla(
         pt = page_tables[bc]  # [C, maxp] owning sequence's pages, in order
         kv = layer_cache[jnp.where(pt >= 0, pt, 0)]  # [C, maxp, ps, 2Hk, Dhp]
         kv = kv.reshape(C, maxp * ps, HkC, Dhp)
+        if kv.dtype == jnp.float8_e4m3fn:
+            # mirror the Pallas kernel's VMEM dequant: fp8 pages upcast at
+            # use; scores already run f32 and p@v must not run in fp8
+            kv = kv.astype(qc.dtype)
         kc, vc = kv[:, :, 0::2], kv[:, :, 1::2]  # [C, S, Hk, Dhp]
         qg = qc.reshape(C, Hk, qpk, Dhp)
         s = jnp.einsum("nkqd,nskd->nkqs", qg.astype(jnp.float32),

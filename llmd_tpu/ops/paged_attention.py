@@ -110,6 +110,14 @@ def paged_attention_tpu(
     # prefetched DMA would read out of bounds — clamp to page 0 (never attended:
     # those entries lie at/past kv_len).
     page_tables = jnp.maximum(page_tables, 0)
+    extra = {}
+    if layer_cache.dtype == jnp.float8_e4m3fn:
+        # fp8 pages: unit scales make the kernel dequantize each KV block in
+        # VMEM right after the page DMA (write_kv stores at scale 1.0 — e4m3's
+        # dynamic range covers K/V activations), halving the HBM KV stream.
+        # Requires 2*Hk % 4 == 0 (strided fp8 load packing), true for every
+        # registry model (Hk >= 2 and even).
+        extra = {"k_scale": 1.0, "v_scale": 1.0}
     return _kernel()(
         q,
         layer_cache,
@@ -121,4 +129,5 @@ def paged_attention_tpu(
         num_kv_pages_per_block=bkv,
         num_queries_per_block=bq,
         vmem_limit_bytes=VMEM_LIMIT,
+        **extra,
     )

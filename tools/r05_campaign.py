@@ -30,7 +30,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # pre-fix rows stale) and exploratory points run last.
 POINTS: list[tuple[str, list[str]]] = [
     ("int8-b64", ["--quantize", "int8", "--batch", "64"]),   # serving default
+    # fp8 KV pool: halves decode's SECOND HBM stream (per-step KV reads rival
+    # the int8 weight bytes at b>=64) — kernel dequantizes pages in VMEM
+    ("int8-b64-kvfp8", ["--quantize", "int8", "--batch", "64",
+                        "--kv-dtype", "fp8"]),
     ("int8-b128", ["--quantize", "int8", "--batch", "128"]),
+    ("int8-b128-kvfp8", ["--quantize", "int8", "--batch", "128",
+                         "--kv-dtype", "fp8"]),
     # layer-scan unroll A/B at the serving default: can XLA hide part of the
     # weight stream behind compute across layer boundaries?
     ("int8-b64-unroll4", ["--quantize", "int8", "--batch", "64",
@@ -45,6 +51,10 @@ POINTS: list[tuple[str, list[str]]] = [
                          "--quantize", "none"]),
     ("longctx-int8", ["--isl", "2048", "--osl", "128", "--batch", "16",
                       "--quantize", "int8"]),
+    # at ISL 2048 the per-step KV read dwarfs the weight stream — the regime
+    # where the fp8 pool pays most
+    ("longctx-int8-kvfp8", ["--isl", "2048", "--osl", "128", "--batch", "16",
+                            "--quantize", "int8", "--kv-dtype", "fp8"]),
 ]
 
 
