@@ -129,6 +129,12 @@ def main() -> None:
                          "fp8 halves decode's per-step KV read stream — the "
                          "second HBM stream after weights at serving batch. "
                          "default: bf16 until fp8 is validated on-chip")
+    ap.add_argument("--kv-layout", default="auto",
+                    choices=["auto", "packed", "padded"],
+                    help="KV pool lane layout (ops/packed_kv): auto packs "
+                         "f=Dhp/head_dim real KV heads per 128-lane row on "
+                         "eligible models (llama-1b: f=2, halves KV bytes "
+                         "again); padded forces one head per row (A/B)")
     args = ap.parse_args()
     tiny = args.tiny
     if args.cpu:
@@ -194,8 +200,9 @@ def main() -> None:
     elif args.quantize == "none":
         args.quantize = None
     eng_cfg.quantize_weights = args.quantize
-    kv_explicit = args.kv_dtype != "default"
+    kv_explicit = args.kv_dtype != "default" or args.kv_layout != "auto"
     eng_cfg.kv_cache_dtype = "fp8" if args.kv_dtype == "fp8" else None
+    eng_cfg.kv_layout = args.kv_layout
     # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
     # the latency the pipelined decode path exists to hide
     import jax.numpy as jnp
@@ -371,9 +378,12 @@ def main() -> None:
         # the fallback is always the r03-proven bf16 shape — the safety net must
         # not share a failure mode with the int8 default it is rescuing, and the
         # rescue measurement must match the r03 protocol (32 requests, one wave)
+        # kv_layout pinned to the r03-proven padded layout: the safety net
+        # must not rebuild the auto-packed program it may be rescuing from
         eng_cfg = EngineConfig(page_size=16, num_pages=2048, max_model_len=1024,
                                max_batch_size=32, prefill_chunk=256, decode_steps=16,
-                               max_num_batched_tokens=2048, instrument=True)
+                               max_num_batched_tokens=2048, instrument=True,
+                               kv_layout="padded")
         n_req = min(n_req, 32)
         eng, out, wall = build_and_measure(eng_cfg)
     dev = jax.devices()[0]
@@ -433,6 +443,7 @@ def main() -> None:
         "weights": weights_src,
         "quantize": eng_cfg.quantize_weights,
         "kv_cache_dtype": eng.stats.kv_cache_dtype,
+        "kv_layout": eng.stats.kv_layout,
         "attn_backend": eng.attn_backend,
         "attn_fallback_reason": eng.attn_fallback_reason,
         "moe_backend": eng.moe_backend,

@@ -75,9 +75,11 @@ def insert_blocks(cache, page_ids: list[int], blocks: np.ndarray,
     dev = jnp.asarray(np.moveaxis(blocks, 0, 1))
     if cache.dtype == jnp.float8_e4m3fn and dev.dtype != cache.dtype:
         # heterogeneous P/D pair (peer shipped wider KV): e4m3 has no inf, so
-        # a bare convert turns |v| > 448 into nan and poisons the page — clamp
-        # exactly like the engine's own write path (transformer.write_kv)
-        dev = jnp.clip(dev.astype(jnp.float32), -448.0, 448.0)
+        # a bare convert turns out-of-range values into nan and poisons the
+        # page — clamp exactly like the engine's own write path
+        from llmd_tpu.models.transformer import _FP8_MAX
+
+        dev = jnp.clip(dev.astype(jnp.float32), -_FP8_MAX, _FP8_MAX)
     return cache.at[jnp.asarray(rows)].set(dev.astype(cache.dtype))
 
 
@@ -504,6 +506,17 @@ def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
     from llmd_tpu.core.kv_events import block_keys_for_tokens
 
     ps = engine.cfg.page_size
+    L = engine.cache.shape[0] // engine.cfg.num_pages
+    local_shape = (L,) + engine.cache.shape[1:]
+    if pulled.blocks.shape[1:] != local_shape:
+        # heterogeneous P/D pair: peer runs a different pool layout (padded vs
+        # packed) or page geometry — dtype converts fine (insert_blocks) but a
+        # shape mismatch cannot; refuse LOUDLY so a mixed-version rollout reads
+        # as a config error, not silent 100% recompute under pull_failures
+        raise ValueError(
+            f"pulled KV block shape {pulled.blocks.shape[1:]} does not match "
+            f"local pool block shape {local_shape} — P/D peers must agree on "
+            "kv_layout and page geometry (rolling upgrades: pin kv_layout)")
     lora_key = engine._lora_hash_key(lora_id)
     keys = block_keys_for_tokens(token_ids, ps, lora_key, mm_hashes)
     take: list[tuple[int, int]] = []  # (pulled_idx, page_id)

@@ -94,6 +94,13 @@ class EngineConfig:
     # The Pallas kernel dequantizes in VMEM after the page DMA (k_scale/
     # v_scale), so HBM traffic halves end to end. None = model dtype.
     kv_cache_dtype: "str | None" = None
+    # KV pool lane layout (ops/packed_kv): "packed" stores f = Dhp/head_dim
+    # real KV heads per 128-lane row instead of padding each head — for
+    # head_dim-64 models that halves KV bytes again (the padding half of
+    # every page DMA is zeros). "auto" packs whenever the model is eligible
+    # (exact lane fit, Hk divisible); "padded" forces the one-head-per-row
+    # layout; "packed" on an ineligible model is an error.
+    kv_layout: str = "auto"
     # Expert-parallel load balancing with redundant experts (wide-ep --enable-eplb
     # {window_size, step_interval, num_redundant_experts}); None = disabled.
     eplb: Optional[EPLBConfig] = None

@@ -76,6 +76,7 @@ class EngineStats:
     attn_backend: str = ""  # kernel provenance (bench/debug)
     moe_backend: str = ""
     kv_cache_dtype: str = ""  # "bf16" | "fp8" — pool dtype provenance
+    kv_layout: str = ""  # "padded" | "packed-f" — pool lane layout provenance
     sp_attn_backend: Optional[str] = None  # ring layout when sp>1 wired in
     n_ring_prefill_steps: int = 0  # unified steps served by the ring program
     # Per-phase wall-time attribution (bench.py breakdown — every serving-perf
@@ -197,8 +198,20 @@ class LLMEngine:
                 " (supported: 'fp8')")
         self.kv_dtype = (jnp.float8_e4m3fn if engine_cfg.kv_cache_dtype == "fp8"
                          else model_cfg.jax_dtype)
+        from llmd_tpu.ops.packed_kv import pack_factor
+
+        if engine_cfg.kv_layout not in ("auto", "padded", "packed"):
+            raise ValueError(f"unknown kv_layout={engine_cfg.kv_layout!r} "
+                             "(supported: 'auto', 'padded', 'packed')")
+        self.kv_pack = (pack_factor(model_cfg)
+                        if engine_cfg.kv_layout in ("auto", "packed") else 1)
+        if engine_cfg.kv_layout == "packed" and self.kv_pack == 1:
+            raise ValueError(
+                "kv_layout='packed' requires padded_head_dim == f*head_dim "
+                f"and num_kv_heads % f == 0; {model_cfg.name} is ineligible")
         self.cache = init_cache(model_cfg, engine_cfg.num_pages,
-                                engine_cfg.page_size, dtype=self.kv_dtype)
+                                engine_cfg.page_size, dtype=self.kv_dtype,
+                                pack=self.kv_pack)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -236,11 +249,21 @@ class LLMEngine:
         cfg = model_cfg
         mesh = self.mesh
         attn = self._select_attn_impl()
+        if self.kv_pack > 1:
+            from llmd_tpu.ops.packed_kv import make_packed_attn
+
+            # the paged impls (Pallas or XLA) run against the packed pool via
+            # slot-placed queries; the ring program below stays unwrapped (it
+            # attends over chunk activations, not the pool)
+            attn = make_packed_attn(attn, model_cfg, self.kv_pack)
+            self.attn_backend += f"+packed{self.kv_pack}"
         moe_impl = self._select_moe_impl()
         self.stats.attn_backend = self.attn_backend
         self.stats.moe_backend = self.moe_backend
         self.stats.kv_cache_dtype = ("fp8" if self.kv_dtype == jnp.float8_e4m3fn
                                      else str(jnp.dtype(self.kv_dtype).name))
+        self.stats.kv_layout = (f"packed-{self.kv_pack}" if self.kv_pack > 1
+                                else "padded")
         use_lora = self.lora_registry is not None
         lora_scale = engine_cfg.lora.scale if use_lora else 1.0
         NT = self.cfg.batched_tokens
@@ -391,9 +414,12 @@ class LLMEngine:
             dhp = padded_head_dim(c.head_dim)
             ps = self.cfg.page_size
             q = jnp.zeros((1, c.num_heads, dhp), c.jax_dtype)
-            # smoke at the SERVING cache dtype — an fp8 strided-load failure
-            # must surface here (and fall back) rather than strand serving
-            cache = jnp.zeros((2, ps, 2 * c.num_kv_heads, dhp), self.kv_dtype)
+            # smoke at the SERVING cache dtype AND layout — an fp8 strided-load
+            # or packed-shape failure must surface here (and fall back) rather
+            # than strand serving
+            cache = jnp.zeros(
+                (2, ps, 2 * (c.num_kv_heads // self.kv_pack), dhp),
+                self.kv_dtype)
             paged_attention_tpu(
                 q, cache, jnp.zeros((1, 2), jnp.int32), jnp.zeros((1,), jnp.int32),
                 jnp.zeros((1,), jnp.int32), jnp.ones((1,), jnp.int32),

@@ -48,6 +48,10 @@ def main() -> None:
     ap.add_argument("--kv-cache-dtype", default=None, choices=["fp8"],
                     help="fp8 KV pool: halves decode's per-step KV read "
                          "stream (the vLLM --kv-cache-dtype role)")
+    ap.add_argument("--kv-layout", default="auto",
+                    choices=["auto", "packed", "padded"],
+                    help="KV pool lane layout (ops/packed_kv): auto packs "
+                         "head_dim-64 models' KV pairs per 128-lane row")
     ap.add_argument("--cpu-offload-pages", type=int, default=0,
                     help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
     ap.add_argument("--offload-fs-path", default=None,
@@ -95,6 +99,7 @@ def main() -> None:
         dp_ranks=args.dp,
         quantize_weights=args.quantize,
         kv_cache_dtype=args.kv_cache_dtype,
+        kv_layout=args.kv_layout,
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import sys
 import time
 import uuid
 from typing import Optional
@@ -255,8 +256,16 @@ class EngineServer:
             # free producer-side blocks (NIXL-notify semantics)
             self.transfer_client.notify(ktp.remote_host, ktp.remote_port, ktp.remote_request_id)
             return n
-        except Exception:
+        except Exception as e:
             self.transfer_stats["pull_failures"] += 1
+            if isinstance(e, ValueError) and "block shape" in str(e):
+                # peer layout/geometry mismatch is a standing config error —
+                # every pull will fail until fixed; say so once per minute
+                # instead of burying it in the failure counter
+                now = time.monotonic()
+                if now - getattr(self, "_shape_err_ts", 0.0) > 60.0:
+                    self._shape_err_ts = now
+                    print(f"kv-transfer: {e}", file=sys.stderr, flush=True)
             return 0
 
     def _tokenize_body(self, body: dict) -> list[int]:

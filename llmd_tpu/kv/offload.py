@@ -197,23 +197,34 @@ class KVOffloadConnector:
                 # pids -1 → out-of-bounds index dropped by the scatter (padding)
                 rows = jnp.arange(L)[:, None] * P + pids[None, :]  # [L, n]
                 rows = jnp.where(pids[None, :] >= 0, rows, Ptot)
-                return cache.at[rows].set(
-                    jnp.moveaxis(blocks, 0, 1).astype(cache.dtype), mode="drop"
-                )
+                dev = jnp.moveaxis(blocks, 0, 1)
+                if cache.dtype == jnp.float8_e4m3fn and dev.dtype != cache.dtype:
+                    # wider-dtype blob (pre-fp8 tier contents): e4m3 has no
+                    # inf — clamp like the engine write path (transformer._FP8_MAX)
+                    from llmd_tpu.models.transformer import _FP8_MAX
+
+                    dev = jnp.clip(dev.astype(jnp.float32), -_FP8_MAX, _FP8_MAX)
+                return cache.at[rows].set(dev.astype(cache.dtype), mode="drop")
 
             self._load_fn = jax.jit(_load, donate_argnums=(0,))
 
+        S = self.staging_blocks
+        P = self.pages_per_layer or cache.shape[0]
+        L = cache.shape[0] // P
+        block_shape = (L,) + cache.shape[1:]  # [L, ps, 2Hk/f, Dhp]
         arrays: list[np.ndarray] = []
         for h in block_hashes:
             arr = self.store.get(h)
             if arr is None:
                 break
+            if arr.shape != block_shape:
+                # blob persisted under a different pool layout (kv_layout /
+                # restart across an upgrade): hashes fold tokens only, so the
+                # match can't see this — treat as a miss (callers recompute)
+                # rather than crash the step loop on a shape-mismatched scatter
+                break
             arrays.append(arr)
         n_loaded = len(arrays)
-        S = self.staging_blocks
-        P = self.pages_per_layer or cache.shape[0]
-        L = cache.shape[0] // P
-        block_shape = (L,) + cache.shape[1:]  # [L, ps, 2Hk, Dhp]
         for start in range(0, n_loaded, S):
             group = arrays[start : start + S]
             pids = np.full((S,), -1, np.int32)

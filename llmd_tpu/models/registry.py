@@ -24,6 +24,12 @@ MODEL_REGISTRY: dict[str, ModelConfig] = {
         mm_tokens=4, mm_placeholder_id=287, vision_patch=8, vision_image_size=32,
         vision_layers=2, vision_hidden=64, vision_heads=4,
     ),
+    # Llama-3.2-ratio GQA at CI size: head_dim 64 (lane pad = one extra head)
+    # exercises the packed KV layout (ops/packed_kv) on the serving surface.
+    "tiny64": ModelConfig(
+        name="tiny64", vocab_size=288, hidden_size=128, intermediate_size=384,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=64,
+    ),
     "tiny-moe": ModelConfig(
         name="tiny-moe", vocab_size=288, hidden_size=128, intermediate_size=256,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,

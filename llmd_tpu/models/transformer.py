@@ -298,16 +298,21 @@ def moe_block(
 
 
 def init_cache(cfg: ModelConfig, num_pages: int, page_size: int,
-               dtype=None) -> jax.Array:
-    """[L*P, page_size, 2*Hk, Dhp] flat pool: layer l's page p at row l*P + p;
-    K at combined head 2h, V at 2h+1.
+               dtype=None, pack: int = 1) -> jax.Array:
+    """[L*P, page_size, 2*(Hk/pack), Dhp] flat pool: layer l's page p at row
+    l*P + p; K at combined head 2h, V at 2h+1.
 
     ``dtype`` overrides the model dtype for the pool — float8_e4m3fn halves
     decode's KV read stream (EngineConfig.kv_cache_dtype="fp8"); the Pallas
     kernel dequantizes pages in VMEM and the XLA fallback upcasts at use.
+    ``pack`` > 1 stores that many real KV heads per lane row (ops/packed_kv:
+    reclaims the head_dim lane padding; requires Dhp == pack * head_dim).
     """
+    if pack > 1:
+        assert padded_head_dim(cfg.head_dim) == pack * cfg.head_dim
+        assert cfg.num_kv_heads % pack == 0
     return jnp.zeros(
-        (cfg.num_layers * num_pages, page_size, 2 * cfg.num_kv_heads,
+        (cfg.num_layers * num_pages, page_size, 2 * (cfg.num_kv_heads // pack),
          padded_head_dim(cfg.head_dim)),
         dtype if dtype is not None else cfg.jax_dtype,
     )
@@ -328,9 +333,17 @@ def write_kv(flat_cache: jax.Array, k: jax.Array, v: jax.Array, slots: jax.Array
     (routed out of bounds and dropped by the scatter).
     """
     S, HkC, Dhp = flat_cache.shape
+    N, Hk, _ = k.shape
     idx = jnp.where(slots >= 0, slots, S)
-    # interleave K/V per head: [N, Hk, 2, Dhp] → [N, 2*Hk, Dhp], K even / V odd
-    kv = jnp.stack([k, v], axis=2).reshape(k.shape[0], HkC, Dhp)
+    if HkC < 2 * Hk:
+        # packed layout (ops/packed_kv): f real heads per lane row — strip the
+        # lane padding and concatenate adjacent heads in slot order
+        f = 2 * Hk // HkC
+        Dh = Dhp // f
+        k = k[:, :, :Dh].reshape(N, Hk // f, Dhp)
+        v = v[:, :, :Dh].reshape(N, Hk // f, Dhp)
+    # interleave K/V per (packed) head: K even / V odd combined index
+    kv = jnp.stack([k, v], axis=2).reshape(N, HkC, Dhp)
     if flat_cache.dtype == jnp.float8_e4m3fn:
         kv = jnp.clip(kv.astype(jnp.float32), -_FP8_MAX, _FP8_MAX)
     kv = kv.astype(flat_cache.dtype)

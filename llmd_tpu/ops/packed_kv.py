@@ -1,0 +1,78 @@
+"""Packed KV layout: reclaim the lane-padding share of the KV stream.
+
+The ragged-paged-attention kernel requires head_dim padded to the 128-lane
+tile ([P, ps, 2*Hk, Dhp] — models.transformer.init_cache). For head_dim-64
+models (llama-1b, every Llama-3.2-class shape) that means HALF of every KV
+byte DMA'd from HBM is zero padding: at serving batch 64 / ctx 320 the
+padded bf16 KV read is ~1.3 GB per decode step, of which ~0.65 GB is zeros.
+
+The fix is a layout, not a kernel: pack ``f = Dhp // Dh`` real KV heads into
+ONE 128-lane row —
+
+    packed cache [P, ps, 2*(Hk/f), f*Dh]    K of pack p = [k_{pf} | … | k_{pf+f-1}]
+
+and give the stock kernel queries zero-padded into their head's lane slot,
+so the per-head dot products are EXACT through the padding algebra:
+
+    [0 … q … 0] . [k_{pf} | … | k_{pf+f-1}] = q . k_{pf+j}   (slot j)
+
+Scores equal the per-head scores bitwise (the cross terms multiply exact
+zeros), so softmax and the p@V product match the padded layout; each query
+row's correct output slot is selected after the kernel. The kernel sees an
+ordinary GQA problem with Hk/f KV heads of dim f*Dh and f*G queries per KV
+head — no fork, no custom Mosaic. Grouping stays contiguous: q heads
+[pfG, (p+1)fG) already map to real KV heads pf..pf+f-1 in slot order.
+
+Eligible when padded_head_dim(Dh) == f*Dh exactly and Hk % f == 0; composes
+with the fp8 pool (llama-1b: packed combined heads 8, fp8 strided-load
+packing 4 divides it) for a combined 4x KV-stream cut vs padded bf16.
+The zig-zag ring path is orthogonal — it attends over pre-cache chunk
+activations, never the pool layout.
+
+Reference baselines serve unpadded head_dim-64 KV natively on GPU
+(FlashInfer has no lane-tile constraint); this restores that parity on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pack_factor(cfg) -> int:
+    """How many real KV heads share one lane row (1 = padded layout)."""
+    from llmd_tpu.models.transformer import padded_head_dim
+
+    dhp = padded_head_dim(cfg.head_dim)
+    f = dhp // cfg.head_dim
+    if f > 1 and dhp == f * cfg.head_dim and cfg.num_kv_heads % f == 0:
+        return f
+    return 1
+
+
+def make_packed_attn(inner, cfg, f: int):
+    """Wrap a uniform-signature paged-attention impl (Pallas or XLA reference)
+    so it runs against the packed pool. ``inner`` sees q rows placed in their
+    lane slot and the packed cache; callers keep the standard [N, H, Dhp]
+    contract (forward_core slices [..., :Dh] after)."""
+    Dh, H, Hk = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    G = H // Hk  # q heads per real kv head
+    eye = jnp.eye(f)
+
+    def impl(q, layer_cache, page_tables, positions, seq_slots, kv_lens, *,
+             scale, cu_q_lens=None, num_seqs=None, chunk_k=None, chunk_v=None):
+        del chunk_k, chunk_v  # paged impls ignore them (ring never wraps)
+        N = q.shape[0]
+        qc = q[:, :, :Dh].reshape(N, Hk // f, f, G, Dh)
+        # slot placement: head j of pack p → lanes [j*Dh, (j+1)*Dh)
+        qp = jnp.einsum("npjgd,jk->npjgkd", qc, eye.astype(qc.dtype))
+        qp = qp.reshape(N, H, f * Dh)
+        out = inner(qp, layer_cache, page_tables, positions, seq_slots,
+                    kv_lens, scale=scale, cu_q_lens=cu_q_lens,
+                    num_seqs=num_seqs)
+        o = out.reshape(N, Hk // f, f, G, f, Dh)
+        merged = jnp.einsum("npjgkd,jk->npjgd", o, eye.astype(o.dtype))
+        merged = merged.reshape(N, H, Dh)
+        # back to the padded contract
+        return jnp.pad(merged, ((0, 0), (0, 0), (0, (f - 1) * Dh)))
+
+    return impl

@@ -115,8 +115,10 @@ def paged_attention_tpu(
         # fp8 pages: unit scales make the kernel dequantize each KV block in
         # VMEM right after the page DMA (write_kv stores at scale 1.0 — e4m3's
         # dynamic range covers K/V activations), halving the HBM KV stream.
-        # Requires 2*Hk % 4 == 0 (strided fp8 load packing), true for every
-        # registry model (Hk >= 2 and even).
+        # Kernel precondition: combined heads % 4 == 0 (strided fp8 load
+        # packing). True for llama-1b both padded (16) and packed (8); NOT for
+        # tiny CI models with 2 combined heads — there the engine's smoke
+        # compile fails and serving falls back to the XLA reference impl.
         extra = {"k_scale": 1.0, "v_scale": 1.0}
     return _kernel()(
         q,

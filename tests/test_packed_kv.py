@@ -1,0 +1,218 @@
+"""Packed KV layout (ops/packed_kv): head_dim-64 models waste half of every
+KV page DMA on lane padding ([P, ps, 2*Hk, 128] with 64 real lanes). Packing
+f = Dhp/Dh real heads per lane row reclaims it with the STOCK kernel — the
+zero-padded query slots make per-head scores bitwise-exact, so the packed
+engine must replay the padded engine's greedy tokens identically. These
+tests pin the eligibility gate, op-level parity against the padded XLA
+reference, engine end-to-end parity (f=2 and f=4), fp8 composition, offload
+replay, and the explicit-config error contract."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine import EngineConfig, LLMEngine
+from llmd_tpu.models import get_model_config
+from llmd_tpu.models.transformer import (
+    padded_head_dim,
+    ragged_paged_attention_xla,
+    write_kv,
+)
+from llmd_tpu.ops.packed_kv import make_packed_attn, pack_factor
+
+
+def _gen(eng, prompt, n=8):
+    eng.add_request("r", list(prompt),
+                    SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True))
+    out = []
+    while eng.has_work():
+        for o in eng.step():
+            out.extend(o.new_token_ids)
+    return out
+
+
+def _cfg64():
+    # head_dim 64: padded to 128, f=2 — the llama-1b / Llama-3.2 shape
+    return replace(get_model_config("tiny"), head_dim=64)
+
+
+def _cfg32x4():
+    # head_dim 32 with 4 KV heads: f=4 packing exercises the general slot math
+    return replace(get_model_config("tiny"), num_kv_heads=4, num_heads=8,
+                   head_dim=32)
+
+
+def test_pack_factor_eligibility():
+    assert pack_factor(get_model_config("tiny")) == 1  # Hk=2 not divisible by 4
+    assert pack_factor(_cfg64()) == 2
+    assert pack_factor(_cfg32x4()) == 4
+    assert pack_factor(get_model_config("llama-1b")) == 2  # the flagship wins
+    assert pack_factor(get_model_config("llama-8b")) == 1  # head_dim 128: no pad
+    assert pack_factor(get_model_config("qwen-32b")) == 1
+
+
+def test_wrapped_op_matches_padded_reference():
+    """Op-level parity: same logical K/V laid out packed vs padded, wrapped
+    impl vs direct XLA reference — outputs bitwise-equal in the real lanes
+    (the packing algebra only ever adds exact zeros)."""
+    for cfg in (_cfg64(), _cfg32x4()):
+        f = pack_factor(cfg)
+        Dh, Hk, H = cfg.head_dim, cfg.num_kv_heads, cfg.num_heads
+        Dhp = padded_head_dim(Dh)
+        ps, P = 8, 4
+        rng = np.random.default_rng(f)
+        N = 6  # mixed ragged batch: seq0 has 5 queries, seq1 has 1 (decode)
+        kv_len = np.array([13, 9], np.int32)
+        padded = jnp.zeros((P * ps, 2 * Hk, Dhp), jnp.float32)
+        packed = jnp.zeros((P * ps, 2 * (Hk // f), Dhp), jnp.float32)
+        # one write path populates both layouts from identical K/V
+        nk = int(kv_len.max())
+        k = jnp.asarray(rng.normal(size=(2 * nk, Hk, Dhp)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(2 * nk, Hk, Dhp)), jnp.float32)
+        k = k.at[:, :, Dh:].set(0.0)  # lane padding is zero by construction
+        v = v.at[:, :, Dh:].set(0.0)
+        # seq 0 occupies pages 0-1, seq 1 pages 2-3 (ps=8, up to 16 tokens)
+        slots = jnp.asarray(
+            [0 * ps + i for i in range(kv_len[0])]
+            + [2 * ps + i for i in range(kv_len[1])], jnp.int32)
+        rows = jnp.concatenate([k[: kv_len[0]], k[nk : nk + kv_len[1]]]), \
+            jnp.concatenate([v[: kv_len[0]], v[nk : nk + kv_len[1]]])
+        padded = write_kv(padded, rows[0], rows[1], slots)
+        packed = write_kv(packed, rows[0], rows[1], slots)
+
+        q = jnp.asarray(rng.normal(size=(N, H, Dhp)), jnp.float32)
+        q = q.at[:, :, Dh:].set(0.0)
+        page_tables = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        positions = jnp.asarray([8, 9, 10, 11, 12, 8], jnp.int32)
+        seq_slots = jnp.asarray([0, 0, 0, 0, 0, 1], jnp.int32)
+        kv_lens = jnp.asarray(kv_len)
+        kw = dict(scale=Dh ** -0.5,
+                  cu_q_lens=jnp.asarray([0, 5, 6], jnp.int32),
+                  num_seqs=jnp.asarray([2], jnp.int32))
+        ref = ragged_paged_attention_xla(
+            q, padded.reshape(P, ps, 2 * Hk, Dhp), page_tables, positions,
+            seq_slots, kv_lens, **kw)
+        wrapped = make_packed_attn(ragged_paged_attention_xla, cfg, f)
+        got = wrapped(q, packed.reshape(P, ps, 2 * (Hk // f), Dhp), page_tables,
+                      positions, seq_slots, kv_lens, **kw)
+        np.testing.assert_allclose(np.asarray(got[..., :Dh], np.float32),
+                                   np.asarray(ref[..., :Dh], np.float32),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_packed_engine_replays_padded_greedy():
+    """End-to-end: packed and padded engines with identical seeds produce
+    identical greedy tokens (the layout is exact, not approximate)."""
+    for cfg in (_cfg64(), _cfg32x4()):
+        base = dict(page_size=8, num_pages=64, max_model_len=256,
+                    max_batch_size=4, prefill_chunk=16)
+        packed = LLMEngine(cfg, EngineConfig(**base, kv_layout="packed"), seed=0)
+        padded = LLMEngine(cfg, EngineConfig(**base, kv_layout="padded"), seed=0)
+        f = pack_factor(cfg)
+        assert packed.stats.kv_layout == f"packed-{f}"
+        assert padded.stats.kv_layout == "padded"
+        assert packed.cache.shape[2] == 2 * (cfg.num_kv_heads // f)
+        prompt = list(range(5, 45))  # 40 tokens: several prefill chunks
+        assert _gen(packed, prompt) == _gen(padded, prompt)
+
+
+def test_auto_layout_packs_eligible_models_only():
+    eng = LLMEngine(get_model_config("tiny"),
+                    EngineConfig(page_size=8, num_pages=32), seed=0)
+    assert eng.kv_pack == 1 and eng.stats.kv_layout == "padded"
+    eng64 = LLMEngine(_cfg64(), EngineConfig(page_size=8, num_pages=32), seed=0)
+    assert eng64.kv_pack == 2 and eng64.stats.kv_layout == "packed-2"
+
+
+def test_packed_composes_with_fp8_and_int8():
+    """The full bandwidth stack: int8 weights + fp8 pool + packed lanes —
+    4x less KV traffic than padded bf16, still greedy-deterministic."""
+    cfg = _cfg64()
+    base = dict(page_size=8, num_pages=64, max_model_len=256, max_batch_size=4,
+                prefill_chunk=16, quantize_weights="int8", kv_cache_dtype="fp8")
+    a = LLMEngine(cfg, EngineConfig(**base, kv_layout="packed"), seed=0)
+    assert a.cache.dtype == jnp.float8_e4m3fn and a.kv_pack == 2
+    out = _gen(a, list(range(9, 49)), n=6)
+    assert len(out) == 6
+    b = LLMEngine(cfg, EngineConfig(**base, kv_layout="packed"), seed=0)
+    assert _gen(b, list(range(9, 49)), n=6) == out
+
+
+def test_packed_offload_reload_replays():
+    """Offload demote/reload moves packed rows; replaying the evicted prompt
+    reloads instead of recomputing and matches the cold output."""
+    cfg = _cfg64()
+    eng = LLMEngine(cfg, EngineConfig(
+        page_size=8, num_pages=12, max_model_len=256, max_batch_size=2,
+        prefill_chunk=32, kv_layout="packed", cpu_offload_pages=64), seed=0)
+    greedy = SamplingParams(max_tokens=6, temperature=0.0)
+    prompt_a = list(range(1, 49))
+    cold = eng.generate([prompt_a], greedy)["req-0"]
+    eng.generate([list(range(100, 170))], greedy)  # pressure: A demotes
+    assert len(eng.offload.store) > 0
+    assert eng.generate([prompt_a], greedy)["req-0"] == cold
+    assert eng.stats.total_offload_loads > 0
+
+
+def test_heterogeneous_pd_layout_rejected_loudly():
+    """A P/D pair that disagrees on kv_layout must fail the inject with a
+    config-error message, not silently scatter mismatched shapes (the blanket
+    pull-failure handler would otherwise hide 100% recompute)."""
+    import pytest
+
+    from llmd_tpu.core.kv_events import block_keys_for_tokens
+    from llmd_tpu.disagg.transfer import PulledKV, inject_into_engine
+
+    cfg = _cfg64()
+    dec = LLMEngine(cfg, EngineConfig(page_size=8, num_pages=32,
+                                      max_model_len=128, max_batch_size=2,
+                                      kv_layout="packed"), seed=0)
+    toks = list(range(1, 17))
+    keys = block_keys_for_tokens(toks, 8, None, ())
+    # peer exported PADDED blocks: combined heads 2*Hk instead of 2*(Hk/f)
+    L, Dhp = cfg.num_layers, padded_head_dim(cfg.head_dim)
+    blocks = np.zeros((2, L, 8, 2 * cfg.num_kv_heads, Dhp), np.float32)
+    pulled = PulledKV(block_hashes=keys, token_chunks=[toks[:8], toks[8:]],
+                      blocks=blocks)
+    with pytest.raises(ValueError, match="block shape"):
+        inject_into_engine(dec, pulled, toks)
+
+
+def test_offload_blob_from_other_layout_is_a_miss():
+    """FS/CPU-tier blobs persisted under a different pool layout must read as
+    misses (recompute), never crash the step loop on a mismatched scatter."""
+    cfg = _cfg64()
+    eng = LLMEngine(cfg, EngineConfig(
+        page_size=8, num_pages=12, max_model_len=256, max_batch_size=2,
+        prefill_chunk=32, kv_layout="packed", cpu_offload_pages=64), seed=0)
+    greedy = SamplingParams(max_tokens=4, temperature=0.0)
+    prompt = list(range(1, 49))
+    cold = eng.generate([prompt], greedy)["req-0"]
+    eng.generate([list(range(100, 170))], greedy)  # demote A's pages
+    store = eng.offload.store
+    assert len(store) > 0
+    # corrupt every blob to the PADDED layout shape (a pre-upgrade tier)
+    for h in list(store._blocks):
+        blob = store._blocks[h]
+        store._blocks[h] = np.zeros(
+            (blob.shape[0], blob.shape[1], 2 * cfg.num_kv_heads, blob.shape[3]),
+            blob.dtype)
+    # replay: reload path must treat the foreign blobs as misses and recompute
+    assert eng.generate([prompt], greedy)["req-0"] == cold
+
+
+def test_explicit_packed_on_ineligible_model_rejected():
+    import pytest
+
+    with pytest.raises(ValueError, match="packed"):
+        LLMEngine(get_model_config("tiny"),
+                  EngineConfig(page_size=8, num_pages=32, kv_layout="packed"))
+    with pytest.raises(ValueError, match="kv_layout"):
+        LLMEngine(get_model_config("tiny"),
+                  EngineConfig(page_size=8, num_pages=32, kv_layout="wat"))

@@ -34,6 +34,10 @@ POINTS: list[tuple[str, list[str]]] = [
     # the int8 weight bytes at b>=64) — kernel dequantizes pages in VMEM
     ("int8-b64-kvfp8", ["--quantize", "int8", "--batch", "64",
                         "--kv-dtype", "fp8"]),
+    # layout A/B: the auto default packs llama-1b KV pairs (ops/packed_kv);
+    # this point re-measures with the padded layout to attribute the gain
+    ("int8-b64-padded", ["--quantize", "int8", "--batch", "64",
+                         "--kv-layout", "padded"]),
     ("int8-b128", ["--quantize", "int8", "--batch", "128"]),
     ("int8-b128-kvfp8", ["--quantize", "int8", "--batch", "128",
                          "--kv-dtype", "fp8"]),
