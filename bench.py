@@ -128,7 +128,10 @@ def main() -> None:
                     help="KV-cache pool dtype (EngineConfig.kv_cache_dtype): "
                          "fp8 halves decode's per-step KV read stream — the "
                          "second HBM stream after weights at serving batch. "
-                         "default: bf16 until fp8 is validated on-chip")
+                         "default: bf16 — MEASURED SLOWER as fp8 on v5e "
+                         "(2,732 vs 4,042 tok/s at int8-b64): no native fp8 "
+                         "datapath, so the VMEM dequant costs more than the "
+                         "DMA bytes it saves; kept for fp8-native TPUs (v7x)")
     ap.add_argument("--kv-layout", default="auto",
                     choices=["auto", "packed", "padded"],
                     help="KV pool lane layout (ops/packed_kv): auto packs "

@@ -66,12 +66,20 @@ def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
     cmd = [sys.executable, os.path.join(ROOT, "bench.py")] + extra
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
     t0 = time.monotonic()
+    # stream stderr (bench.py's phase trace) to a per-point log so a
+    # timeout/fabric drop still leaves the trace behind (the b128 1500s
+    # timeout taught us this: capture_output keeps it in a pipe the kill
+    # throws away); stdout stays piped — it only carries the result JSON
+    log_path = os.path.join(ROOT, f"campaign_logs/{name}.log")
+    os.makedirs(os.path.dirname(log_path), exist_ok=True)
     try:
-        p = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
-                           timeout=timeout_s)
+        with open(log_path, "w") as log:
+            p = subprocess.run(cmd, cwd=ROOT, stdout=subprocess.PIPE,
+                               stderr=log, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return {"point": name, "error": f"timeout {timeout_s:.0f}s"}
-    sys.stderr.write(p.stderr[-1500:] + "\n")
+        return {"point": name, "error": f"timeout {timeout_s:.0f}s",
+                "log": log_path}
+    sys.stderr.write(open(log_path).read()[-1500:] + "\n")
     for line in reversed(p.stdout.strip().splitlines()):
         try:
             out = json.loads(line)
@@ -81,7 +89,8 @@ def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
         except json.JSONDecodeError:
             continue
     return {"point": name, "error": f"no JSON (rc={p.returncode})",
-            "tail": (p.stderr or p.stdout)[-400:]}
+            "tail": (open(log_path).read() or p.stdout)[-400:],
+            "log": log_path}
 
 
 def main() -> None:
