@@ -171,6 +171,10 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._outputs: list[EngineOutput] = []
         self._pending_decode: list[dict] = []  # in-flight pipelined decode calls
+        # one in-flight prefill-step sample read (pipelined like decode: the
+        # ~RTT-priced np.asarray of the sampled tokens defers until the NEXT
+        # unified step is on the device, hiding the read behind its compute)
+        self._pending_sample: Optional[dict] = None
 
         if params is None:
             params = init_params(model_cfg, jax.random.PRNGKey(seed))
@@ -791,6 +795,14 @@ class LLMEngine:
             if slot is None:
                 return
             seq = waiting[0]
+            if seq.pages:
+                # a waiting seq must own nothing — preemption empties the
+                # ledger via _free_seq. Anything still here is a scheduling
+                # bug's strays, and they must release BEFORE the capacity
+                # check below: strays hold refs, so a starved pool would
+                # otherwise head-of-line block on the very pages the head
+                # seq itself is leaking.
+                self._free_seq(seq)
             ps = self.cfg.page_size
             # prefix-cache lookup over complete prompt blocks
             from llmd_tpu.core.kv_events import block_keys_for_tokens
@@ -919,11 +931,23 @@ class LLMEngine:
             seq.pages.append(pid)
         return True
 
-    def _preempt_one(self, rank: int = 0) -> bool:
+    def _preempt_one(self, rank: int = 0,
+                     exclude: Optional[Sequence] = None) -> bool:
         """Evict the rank's most recently arrived running seq back to waiting
         (recompute semantics). Pages are rank-partitioned, so only a same-rank
-        victim frees memory the caller can use."""
-        victims = [s for s in self.running if s is not None and s.rank == rank]
+        victim frees memory the caller can use. ``exclude`` is the seq the
+        caller is trying to schedule: evicting it frees its own pages only to
+        reset it to token zero — a thrash loop, never progress."""
+        # Bank any deferred first tokens BEFORE choosing a victim: a pending-
+        # sample seq is idle and page-holding (a prime victim), and evicting
+        # it would drop its un-applied token — full re-prefill, re-defer,
+        # re-evict, a tight-pool ping-pong with zero forward progress. The
+        # flush makes per-seq progress monotonic again (the recompute path
+        # preserves applied tokens); preemption is the rare slow path, so the
+        # extra device read here is noise.
+        self._flush_pending_sample()
+        victims = [s for s in self.running
+                   if s is not None and s.rank == rank and s is not exclude]
         if not victims:
             return False
         victim = max(victims, key=lambda s: s.arrival_time)
@@ -953,6 +977,9 @@ class LLMEngine:
             self._flush_pending_decode()
             self._step_unified()
         else:
+            # decode builds its batch from host token state: the deferred
+            # prefill sample (first tokens) must land first
+            self._flush_pending_sample()
             self._step_decode()
         self.stats.num_waiting = sum(len(q) for q in self.waitq)
         self.stats.num_running = sum(1 for s in self.running if s is not None)
@@ -1015,10 +1042,18 @@ class LLMEngine:
         for s in self._decode_ready():
             if len(plan) >= B:
                 break
+            if s.slot < 0:
+                # preempted while packing an earlier row: the snapshot is
+                # stale. Without this guard the zombie's _ensure_pages can
+                # re-acquire pages onto a seq whose ledger _free_seq already
+                # emptied — pages it carries into the waitq and leaks at
+                # re-admission (measured: 4 pages/occurrence → pool exhaustion
+                # → self-preempt livelock in tight pools)
+                continue
             if budgets[s.rank] <= 0:
                 continue
             if not self._ensure_pages(s, len(s.token_ids)):
-                if not self._preempt_one(s.rank) or s.slot < 0:
+                if not self._preempt_one(s.rank, exclude=s) or s.slot < 0:
                     continue
                 if not self._ensure_pages(s, len(s.token_ids)):
                     continue
@@ -1034,7 +1069,7 @@ class LLMEngine:
             if n <= 0:
                 continue
             if not self._ensure_pages(s, s.num_computed + n):
-                if not self._preempt_one(s.rank) or s.slot < 0:
+                if not self._preempt_one(s.rank, exclude=s) or s.slot < 0:
                     continue
                 if not self._ensure_pages(s, s.num_computed + n):
                     continue
@@ -1042,6 +1077,10 @@ class LLMEngine:
             budgets[s.rank] -= n
         plan = [(s, n, d) for (s, n, d) in plan if s.slot >= 0]
         if not plan:
+            # nothing schedulable — a deferred sample may be WHY (its rows
+            # hold slots/pages until applied, and an apply can retire): flush
+            # it so the next step can make progress instead of spinning
+            self._flush_pending_sample()
             return
 
         toks = np.zeros((NT,), np.int32)
@@ -1108,12 +1147,14 @@ class LLMEngine:
             self._eplb_record(cnt)
 
         sample_list: list[tuple[int, Sequence]] = []  # (batch row, seq)
+        has_decode_rows = False
         for i, (s, n, is_decode) in enumerate(plan):
             if is_decode:
                 s.num_computed = len(s.token_ids)
                 s.maybe_commit_blocks(self.allocs[s.rank])
                 self.stats.total_decode_tokens += 1
                 sample_list.append((i, s))
+                has_decode_rows = True
             else:
                 s.num_computed += n
                 s.maybe_commit_blocks(self.allocs[s.rank])
@@ -1122,8 +1163,22 @@ class LLMEngine:
                         and s.num_computed == s.prompt_len):
                     # fresh prefill complete: sample first token from last logits
                     sample_list.append((i, s))
-        if sample_list:
-            self._sample_and_append(sample_list, logits)
+        # Pipelined sample read: dispatch this step's sampling (device-chained
+        # on step_fn), apply the PREVIOUS step's deferred sample while the
+        # device runs, and defer this one — its rows are unschedulable until
+        # applied (not prefilling: num_computed==target; not decode-ready:
+        # num_computed==len(token_ids)), so the next plan can't race them.
+        # Mixed steps with decode rows apply synchronously: a deferred decode
+        # row would sit out the following step, stalling steady-state ITL.
+        prev, self._pending_sample = self._pending_sample, None
+        rec = self._sample_dispatch(sample_list, logits) if sample_list else None
+        if prev is not None:
+            self._sample_apply(prev)
+        if rec is not None:
+            if self.cfg.pipeline_prefill_sample and not has_decode_rows:
+                self._pending_sample = rec
+            else:
+                self._sample_apply(rec)
         t3 = time.perf_counter()
         st = self.stats
         st.time_host_pack += t1 - t0
@@ -1367,9 +1422,10 @@ class LLMEngine:
         self._free_seq(seq)
         self.seqs.pop(seq.request_id, None)
 
-    def _sample_and_append(self, rows_and_seqs: list[tuple[int, "Sequence"]],
-                           logits: jax.Array) -> None:
-        """Sample one token per (row, seq) pair from row-indexed logits [B, vocab]."""
+    def _sample_dispatch(self, rows_and_seqs: list[tuple[int, "Sequence"]],
+                         logits: jax.Array) -> dict:
+        """Launch sampling on device (chains on the step that made ``logits``)
+        and start the device->host copy; no sync point here."""
         B = logits.shape[0]
         temp = np.zeros((B,), np.float32)
         tk = np.zeros((B,), np.int32)
@@ -1380,11 +1436,27 @@ class LLMEngine:
             tk[i] = sp.top_k
             tp[i] = sp.top_p
         self._key, sub = jax.random.split(self._key)
-        sampled = np.asarray(
-            sample_tokens(logits.astype(jnp.float32), sub, jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
-        )
+        sampled = sample_tokens(logits.astype(jnp.float32), sub,
+                                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
+        try:
+            sampled.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        return {"sampled": sampled,
+                "rows": [(i, s, s.slot) for i, s in rows_and_seqs]}
+
+    def _flush_pending_sample(self) -> None:
+        rec, self._pending_sample = self._pending_sample, None
+        if rec is not None:
+            self._sample_apply(rec)
+
+    def _sample_apply(self, rec: dict) -> None:
+        """Read one dispatched sample's tokens (device sync point) and apply."""
+        sampled = np.asarray(rec["sampled"])
         now = time.monotonic()
-        for i, s in rows_and_seqs:
+        for i, s, slot in rec["rows"]:
+            if s.finished or s.slot != slot or self.running[slot] is not s:
+                continue  # aborted / preempted while the sample was in flight
             tok = int(sampled[i])
             s.token_ids.append(tok)
             if s.first_token_time is None:
