@@ -82,6 +82,12 @@ def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
     for line in reversed(p.stdout.strip().splitlines()):
         try:
             out = json.loads(line)
+            if out.get("source"):
+                # bench.py's device-unavailable path can re-emit a HARVESTED
+                # row (flag-default invocation only, but belt-and-braces):
+                # relabeling it to this point would fabricate a measurement
+                return {"point": name, "error": "device-unavailable",
+                        "note": "bench returned harvested fallback, discarded"}
             out["point"] = name
             out["wall_total_s"] = round(time.monotonic() - t0, 1)
             return out
