@@ -219,10 +219,15 @@ class LLMEngine:
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            # combined-head dim (2*Hk) shards over tp: K/V pairs stay together
+            # combined-head dim (2*Hk) shards over tp: K/V pairs stay together.
+            # MLA replicates instead — its single shared latent "head" (axis
+            # size 2) cannot split across tp ranks, and every head's shard
+            # needs the full latent anyway (DeepSeek TP layout: heads shard,
+            # latent KV replicates)
+            spec = (P(None, None, None, None) if model_cfg.is_mla
+                    else P(None, None, "tp", None))
             self.cache = jax.device_put(
-                self.cache, NamedSharding(self.mesh, P(None, None, "tp", None))
-            )
+                self.cache, NamedSharding(self.mesh, spec))
 
         self._eplb = None
         if engine_cfg.eplb is not None and model_cfg.is_moe:
@@ -231,6 +236,13 @@ class LLMEngine:
         self.lora_registry = None
         self._lora_params: dict[str, jax.Array] = {}
         if engine_cfg.lora is not None:
+            if model_cfg.is_mla:
+                # the MLA attention branch applies no adapter deltas — serving
+                # would silently return base-model outputs under adapter names
+                raise ValueError(
+                    "LoRA adapters are not supported on MLA models (the "
+                    "absorbed-attention path has no adapter hook); remove "
+                    "EngineConfig.lora or use a GQA model")
             from llmd_tpu.models.lora import LoRARegistry, init_lora_params
 
             self.lora_registry = LoRARegistry(engine_cfg.lora.max_adapters)
@@ -379,7 +391,12 @@ class LLMEngine:
         self._unified_ring_fn = None
         self.sp_attn_backend: Optional[str] = None
         if (mesh is not None and engine_cfg.mesh.sp > 1
-                and engine_cfg.sp_ring_attention and NT % engine_cfg.mesh.sp == 0):
+                and engine_cfg.sp_ring_attention and NT % engine_cfg.mesh.sp == 0
+                # MLA should compose (absorbed attention is MQA over the
+                # latent, a GQA special case the ring handles) but is unproven
+                # against the ring program — flat-token GSPMD sharding serves
+                # sp>1 MLA prefills until a parity test lands
+                and not model_cfg.is_mla):
             from llmd_tpu.ops.ring_attention import make_ring_attn_impl
 
             # ONE layout decision, passed down — sp_flash_prefill would
@@ -399,6 +416,21 @@ class LLMEngine:
         Records provenance in ``attn_backend`` / ``attn_fallback_reason``."""
         self.attn_fallback_reason: Optional[str] = None
         mode = self.cfg.attn_impl
+        if self.model_cfg.is_mla:
+            # Absorbed MLA runs as MQA with head_dim = latent rank + rope dim
+            # (typically 288–640 lanes) — past the Pallas kernel's supported
+            # head sizes; the XLA impl handles it at any width. The absorbed
+            # math itself is the win: per-token KV is ~4–8x smaller, so the
+            # gather the XLA path pays streams proportionally fewer bytes.
+            if mode == "pallas":
+                # explicit 'pallas' is a hard guarantee everywhere else —
+                # honor the contract rather than silently downgrading
+                raise ValueError(
+                    "attn_impl='pallas' cannot serve MLA models (latent "
+                    "head_dim exceeds the kernel's head sizes); use 'auto'")
+            self.attn_backend = "xla_mla_absorbed"
+            self.attn_fallback_reason = "mla: latent head_dim beyond Pallas kernel"
+            return ragged_paged_attention_xla
         if mode == "reference":
             self.attn_backend = "xla_reference"
             return ragged_paged_attention_xla

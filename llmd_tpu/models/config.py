@@ -47,6 +47,18 @@ class ModelConfig:
     vision_layers: int = 0
     vision_hidden: int = 0
     vision_heads: int = 4
+    # Multi-head latent attention (DeepSeek-V2/V3 family — the architecture of
+    # the reference's wide-EP north-star benchmarks, guides/wide-ep-lws). KV is
+    # compressed to a shared per-token latent c_kv [mla_kv_lora_rank] plus a
+    # decoupled RoPE key [mla_rope_dim]; attention runs ABSORBED (q projected
+    # into latent space through W_UK, output re-expanded through W_UV), which
+    # makes it exactly MQA with head_dim = rank + rope_dim over the paged pool
+    # — per-token KV bytes shrink ~(2*Hk*Dh)/(rank+rope) vs GQA.
+    # 0 = standard GQA attention.
+    mla_kv_lora_rank: int = 0
+    mla_rope_dim: int = 0
+    mla_qk_nope_dim: int = 0  # per-head non-RoPE q/k dim (score dot in latent space)
+    mla_v_head_dim: int = 0  # per-head value dim after W_UV re-expansion
 
     @property
     def has_vision(self) -> bool:
@@ -63,3 +75,18 @@ class ModelConfig:
     @property
     def q_per_kv(self) -> int:
         return self.num_heads // self.num_kv_heads
+
+    @property
+    def is_mla(self) -> bool:
+        return self.mla_kv_lora_rank > 0
+
+    @property
+    def kv_cache_heads(self) -> int:
+        """KV heads as stored in the paged pool (1 for MLA's shared latent)."""
+        return 1 if self.is_mla else self.num_kv_heads
+
+    @property
+    def kv_cache_head_dim(self) -> int:
+        """Per-token per-head KV width in the pool (latent + rope key for MLA)."""
+        return (self.mla_kv_lora_rank + self.mla_rope_dim) if self.is_mla \
+            else self.head_dim

@@ -62,6 +62,35 @@ MODEL_REGISTRY: dict[str, ModelConfig] = {
         moe_num_experts=32, moe_top_k=4, moe_intermediate_size=512,
         moe_num_shared_experts=1,
     ),
+    # MLA at CI size (DeepSeek-V2/V3 attention family; ratios mirror V3's
+    # 512-rank / 64-rope / 128-nope / 128-value at 1/8 scale).
+    "tiny-mla": ModelConfig(
+        name="tiny-mla", vocab_size=288, hidden_size=128, intermediate_size=384,
+        num_layers=2, num_heads=4, num_kv_heads=4, head_dim=32,
+        mla_kv_lora_rank=64, mla_rope_dim=16, mla_qk_nope_dim=16,
+        mla_v_head_dim=16,
+    ),
+    # MLA x MoE at CI size: the wide-EP north-star STACK (latent attention +
+    # expert banks) cheap enough for the multichip dryrun and stress tests.
+    "tiny-mla-moe": ModelConfig(
+        name="tiny-mla-moe", vocab_size=288, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=4, num_kv_heads=4,
+        head_dim=32, mla_kv_lora_rank=64, mla_rope_dim=16, mla_qk_nope_dim=16,
+        mla_v_head_dim=16, moe_num_experts=8, moe_top_k=2,
+        moe_intermediate_size=128, moe_num_shared_experts=1,
+    ),
+    # DeepSeek-R1/V3-class wide-EP shape with TRUE MLA latent KV (shape-
+    # faithful scaled stand-in for the reference's north-star model,
+    # guides/wide-ep-lws/README.md): per-token KV is rank+rope = 160 floats
+    # shared across all heads vs 2*4*64 = 512 for the GQA sim above.
+    "moe-wide-mla": ModelConfig(
+        name="moe-wide-mla", vocab_size=32768, hidden_size=1024,
+        intermediate_size=2048, num_layers=4, num_heads=16, num_kv_heads=16,
+        head_dim=64, mla_kv_lora_rank=128, mla_rope_dim=32,
+        mla_qk_nope_dim=32, mla_v_head_dim=32,
+        moe_num_experts=32, moe_top_k=4, moe_intermediate_size=512,
+        moe_num_shared_experts=1,
+    ),
 }
 
 

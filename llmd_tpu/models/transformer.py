@@ -82,11 +82,27 @@ def param_logical_axes(cfg: ModelConfig) -> dict[str, Any]:
         # stacked per-layer leaves carry a leading 'layers' axis
         "attn_norm": ("layers", "embed"),
         "mlp_norm": ("layers", "embed"),
-        "wq": ("layers", "embed", "heads", "head_dim"),
-        "wk": ("layers", "embed", "kv_heads", "head_dim"),
-        "wv": ("layers", "embed", "kv_heads", "head_dim"),
-        "wo": ("layers", "heads", "head_dim", "embed"),
     }
+    if cfg.is_mla:
+        # TP shards over heads for W_Q/W_UK/W_UV/W_O; the latent path
+        # (W_DKV/W_KR, the per-token shared c_kv) is replicated — it is tiny
+        # and every head's shard needs the full latent (DeepSeek TP layout).
+        axes |= {
+            "mla_wq": ("layers", "embed", "heads", "head_dim"),
+            "mla_wdkv": ("layers", "embed", None),
+            "mla_wkr": ("layers", "embed", None),
+            "mla_kv_norm": ("layers", None),
+            "mla_wuk": ("layers", "heads", "head_dim", None),
+            "mla_wuv": ("layers", "heads", None, "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+        }
+    else:
+        axes |= {
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+        }
     if cfg.qk_norm:
         axes |= {"q_norm": ("layers", "head_dim"), "k_norm": ("layers", "head_dim")}
     if cfg.attn_bias:
@@ -130,11 +146,26 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict[str, jax.Array]:
         "final_norm": jnp.ones((D,), dt),
         "attn_norm": jnp.ones((L, D), dt),
         "mlp_norm": jnp.ones((L, D), dt),
-        "wq": norm((L, D, H, Dh), s),
-        "wk": norm((L, D, Hk, Dh), s),
-        "wv": norm((L, D, Hk, Dh), s),
-        "wo": norm((L, H, Dh, D), (H * Dh) ** -0.5),
     }
+    if cfg.is_mla:
+        # DeepSeek-V2/V3 latent attention (deepseek-ai modeling: kv_a_proj
+        # W_DKV + decoupled-RoPE key W_KR, up-projections W_UK/W_UV absorbed
+        # at inference). No wk/wv — the pool stores [c_kv ; k_rope] once per
+        # token, shared by every head.
+        r, dr = cfg.mla_kv_lora_rank, cfg.mla_rope_dim
+        dn, dv = cfg.mla_qk_nope_dim, cfg.mla_v_head_dim
+        p["mla_wq"] = norm((L, D, H, dn + dr), s)
+        p["mla_wdkv"] = norm((L, D, r), s)
+        p["mla_wkr"] = norm((L, D, dr), s)
+        p["mla_kv_norm"] = jnp.ones((L, r), dt)
+        p["mla_wuk"] = norm((L, H, dn, r), dn ** -0.5)
+        p["mla_wuv"] = norm((L, H, r, dv), r ** -0.5)
+        p["wo"] = norm((L, H, dv, D), (H * dv) ** -0.5)
+    else:
+        p["wq"] = norm((L, D, H, Dh), s)
+        p["wk"] = norm((L, D, Hk, Dh), s)
+        p["wv"] = norm((L, D, Hk, Dh), s)
+        p["wo"] = norm((L, H, Dh, D), (H * Dh) ** -0.5)
     if cfg.qk_norm:
         p["q_norm"] = jnp.ones((L, Dh), dt)
         p["k_norm"] = jnp.ones((L, Dh), dt)
@@ -302,6 +333,11 @@ def init_cache(cfg: ModelConfig, num_pages: int, page_size: int,
     """[L*P, page_size, 2*(Hk/pack), Dhp] flat pool: layer l's page p at row
     l*P + p; K at combined head 2h, V at 2h+1.
 
+    MLA allocates a SINGLE plane — one shared [c_kv ; k_rope] row per token
+    (keys and values are the same latent in absorbed attention, so a second
+    plane would double KV bytes for nothing; write_kv and the XLA impl detect
+    the one-row layout by its odd combined-head count).
+
     ``dtype`` overrides the model dtype for the pool — float8_e4m3fn halves
     decode's KV read stream (EngineConfig.kv_cache_dtype="fp8"); the Pallas
     kernel dequantizes pages in VMEM and the XLA fallback upcasts at use.
@@ -309,11 +345,12 @@ def init_cache(cfg: ModelConfig, num_pages: int, page_size: int,
     reclaims the head_dim lane padding; requires Dhp == pack * head_dim).
     """
     if pack > 1:
-        assert padded_head_dim(cfg.head_dim) == pack * cfg.head_dim
-        assert cfg.num_kv_heads % pack == 0
+        assert padded_head_dim(cfg.kv_cache_head_dim) == pack * cfg.kv_cache_head_dim
+        assert cfg.kv_cache_heads % pack == 0
+    rows = 1 if cfg.is_mla else 2 * (cfg.kv_cache_heads // pack)
     return jnp.zeros(
-        (cfg.num_layers * num_pages, page_size, 2 * (cfg.num_kv_heads // pack),
-         padded_head_dim(cfg.head_dim)),
+        (cfg.num_layers * num_pages, page_size, rows,
+         padded_head_dim(cfg.kv_cache_head_dim)),
         dtype if dtype is not None else cfg.jax_dtype,
     )
 
@@ -335,6 +372,12 @@ def write_kv(flat_cache: jax.Array, k: jax.Array, v: jax.Array, slots: jax.Array
     S, HkC, Dhp = flat_cache.shape
     N, Hk, _ = k.shape
     idx = jnp.where(slots >= 0, slots, S)
+    if HkC == 1:
+        # single-plane MLA pool: k IS the shared latent; v is ignored
+        row = k.astype(jnp.float32) if flat_cache.dtype == jnp.float8_e4m3fn else k
+        if flat_cache.dtype == jnp.float8_e4m3fn:
+            row = jnp.clip(row, -_FP8_MAX, _FP8_MAX)
+        return flat_cache.at[idx].set(row.astype(flat_cache.dtype), mode="drop")
     if HkC < 2 * Hk:
         # packed layout (ops/packed_kv): f real heads per lane row — strip the
         # lane padding and concatenate adjacent heads in slot order
@@ -376,7 +419,10 @@ def ragged_paged_attention_xla(
     """
     N, H, Dhp = q.shape
     Pn, ps, HkC, _ = layer_cache.shape
-    Hk = HkC // 2
+    # HkC == 1: single-plane MLA pool — the stored latent serves as BOTH key
+    # and value (absorbed attention), i.e. MQA with shared k==v
+    single_plane = HkC == 1
+    Hk = 1 if single_plane else HkC // 2
     B, maxp = page_tables.shape
     qpk = H // Hk
 
@@ -397,7 +443,10 @@ def ragged_paged_attention_xla(
             # mirror the Pallas kernel's VMEM dequant: fp8 pages upcast at
             # use; scores already run f32 and p@v must not run in fp8
             kv = kv.astype(qc.dtype)
-        kc, vc = kv[:, :, 0::2], kv[:, :, 1::2]  # [C, S, Hk, Dhp]
+        if single_plane:
+            kc = vc = kv  # [C, S, 1, Dhp] shared latent
+        else:
+            kc, vc = kv[:, :, 0::2], kv[:, :, 1::2]  # [C, S, Hk, Dhp]
         qg = qc.reshape(C, Hk, qpk, Dhp)
         s = jnp.einsum("nkqd,nskd->nkqs", qg.astype(jnp.float32),
                        kc.astype(jnp.float32)) * scale
@@ -479,7 +528,15 @@ def forward_core(
             out += (k,) if k in params else (k + "_q", k + "_scale")
         return out
 
-    stacked_keys = ("attn_norm", "mlp_norm") + _variants("wq", "wk", "wv", "wo") + (
+    if cfg.is_mla:
+        # bias/qk-norm/LoRA-on-attn are GQA-family features; none of the MLA
+        # checkpoints combine them (registry enforces the shapes)
+        assert not (cfg.qk_norm or cfg.attn_bias), "MLA excludes qk_norm/attn_bias"
+        attn_keys = ("mla_wq", "mla_wdkv", "mla_wkr", "mla_kv_norm",
+                     "mla_wuk", "mla_wuv") + _variants("wo")
+    else:
+        attn_keys = _variants("wq", "wk", "wv", "wo")
+    stacked_keys = ("attn_norm", "mlp_norm") + attn_keys + (
         ("q_norm", "k_norm") if cfg.qk_norm else ()
     ) + (("bq", "bk", "bv", "bo") if cfg.attn_bias else ()) + (
         ("router",) + _variants("moe_wi", "moe_wo")
@@ -490,6 +547,8 @@ def forward_core(
     if "eplb_replica_slots" in params:
         stacked_keys += ("eplb_replica_slots", "eplb_replica_counts")
     has_lora = "lora_A_wq" in params
+    assert not (cfg.is_mla and has_lora), \
+        "LoRA adapters are unsupported on MLA models (no adapter hook in the absorbed path)"
     if has_lora:
         from llmd_tpu.models.lora import LORA_TARGETS
 
@@ -518,47 +577,83 @@ def forward_core(
             return y * lp[key + "_scale"].astype(xin.dtype)
 
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps)
-        q = _mm("wq", "nd,dhk->nhk", h)
-        k = _mm("wk", "nd,dhk->nhk", h)
-        v = _mm("wv", "nd,dhk->nhk", h)
-        if cfg.attn_bias:
-            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-        if has_lora:
-            from llmd_tpu.models.lora import apply_lora
+        if cfg.is_mla:
+            # Absorbed MLA (DeepSeek-V2 §2.1.2 inference form): the pool holds
+            # one shared [c_kv ; k_rope] vector per token, queries project into
+            # latent space through W_UK, and the whole thing runs as MQA with
+            # head_dim = rank + rope_dim over the unmodified paged-attention
+            # impl. Scores: q_nope·(W_UK c) + q_rope·k_rope == (W_UK^T q_nope)·c
+            # + q_rope·k_rope; values ARE the latents, re-expanded per head
+            # through W_UV after the softmax-weighted sum.
+            r, dr, dn = cfg.mla_kv_lora_rank, cfg.mla_rope_dim, cfg.mla_qk_nope_dim
+            Dkv = r + dr
 
-            Hq, Hkn = cfg.num_heads, cfg.num_kv_heads
-            q = q + apply_lora(h, lp["lora_A_wq"], lp["lora_B_wq"], lora_indices,
-                               lora_scale).reshape(N, Hq, Dh)
-            k = k + apply_lora(h, lp["lora_A_wk"], lp["lora_B_wk"], lora_indices,
-                               lora_scale).reshape(N, Hkn, Dh)
-            v = v + apply_lora(h, lp["lora_A_wv"], lp["lora_B_wv"], lora_indices,
-                               lora_scale).reshape(N, Hkn, Dh)
-        if cfg.qk_norm:
-            # Per-head RMSNorm over head_dim before RoPE (Qwen3 semantics) — on
-            # the FULL projection output incl. bias and LoRA delta, matching the
-            # HF/PEFT order (adapters are trained against normalised q/k).
-            q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
-            k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-        # this layer's slice of the pool: slots/pages shifted by the layer offset
-        slots_l = jnp.where(slots >= 0, slots + l * (P * ps), -1)
-        pt_l = jnp.where(page_tables >= 0, page_tables + l * P, -1)
-        flat_cache = write_kv(flat_cache, pad_heads(k), pad_heads(v), slots_l)
-        attn = attn_impl(
-            pad_heads(q), flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
-            positions, seq_slots, kv_lens,
-            cu_q_lens=cu_q_lens, num_seqs=num_seqs, scale=Dh ** -0.5,
-            chunk_k=pad_heads(k), chunk_v=pad_heads(v),
-        )
-        attn = attn[..., :Dh]
-        o = _mm("wo", "nhk,hkd->nd", attn)
-        if cfg.attn_bias:
-            o = o + lp["bo"]
-        if has_lora:
-            attn_flat = attn.reshape(N, cfg.num_heads * Dh)
-            o = o + apply_lora(attn_flat, lp["lora_A_wo"], lp["lora_B_wo"],
-                               lora_indices, lora_scale)
+            def pad_kv(t):  # [N, h, Dkv] → [N, h, Dhp]
+                return t if Dhp == Dkv else jnp.pad(
+                    t, ((0, 0), (0, 0), (0, Dhp - Dkv)))
+
+            q = jnp.einsum("nd,dhk->nhk", h, lp["mla_wq"])  # [N, H, dn+dr]
+            q_rope = rope(q[..., dn:], positions, cfg.rope_theta)
+            c = jnp.einsum("nd,dr->nr", h, lp["mla_wdkv"])  # [N, r] latent
+            c = rms_norm(c, lp["mla_kv_norm"], cfg.rms_eps)
+            kr = rope(jnp.einsum("nd,dk->nk", h, lp["mla_wkr"])[:, None, :],
+                      positions, cfg.rope_theta)[:, 0]  # [N, dr] shared key
+            q_lat = jnp.einsum("nhk,hkr->nhr", q[..., :dn], lp["mla_wuk"])
+            q_eff = pad_kv(jnp.concatenate([q_lat, q_rope], axis=-1))
+            kv_eff = pad_kv(jnp.concatenate([c, kr], axis=-1)[:, None, :])
+            slots_l = jnp.where(slots >= 0, slots + l * (P * ps), -1)
+            pt_l = jnp.where(page_tables >= 0, page_tables + l * P, -1)
+            flat_cache = write_kv(flat_cache, kv_eff, kv_eff, slots_l)
+            attn = attn_impl(
+                q_eff, flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
+                positions, seq_slots, kv_lens,
+                cu_q_lens=cu_q_lens, num_seqs=num_seqs,
+                scale=(dn + dr) ** -0.5, chunk_k=kv_eff, chunk_v=kv_eff,
+            )
+            o_heads = jnp.einsum("nhr,hrv->nhv", attn[..., :r], lp["mla_wuv"])
+            o = _mm("wo", "nhv,hvd->nd", o_heads)
+        else:
+            q = _mm("wq", "nd,dhk->nhk", h)
+            k = _mm("wk", "nd,dhk->nhk", h)
+            v = _mm("wv", "nd,dhk->nhk", h)
+            if cfg.attn_bias:
+                q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            if has_lora:
+                from llmd_tpu.models.lora import apply_lora
+
+                Hq, Hkn = cfg.num_heads, cfg.num_kv_heads
+                q = q + apply_lora(h, lp["lora_A_wq"], lp["lora_B_wq"], lora_indices,
+                                   lora_scale).reshape(N, Hq, Dh)
+                k = k + apply_lora(h, lp["lora_A_wk"], lp["lora_B_wk"], lora_indices,
+                                   lora_scale).reshape(N, Hkn, Dh)
+                v = v + apply_lora(h, lp["lora_A_wv"], lp["lora_B_wv"], lora_indices,
+                                   lora_scale).reshape(N, Hkn, Dh)
+            if cfg.qk_norm:
+                # Per-head RMSNorm over head_dim before RoPE (Qwen3 semantics) — on
+                # the FULL projection output incl. bias and LoRA delta, matching the
+                # HF/PEFT order (adapters are trained against normalised q/k).
+                q = rms_norm(q, lp["q_norm"], cfg.rms_eps)
+                k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
+            q = rope(q, positions, cfg.rope_theta)
+            k = rope(k, positions, cfg.rope_theta)
+            # this layer's slice of the pool: slots/pages shifted by the layer offset
+            slots_l = jnp.where(slots >= 0, slots + l * (P * ps), -1)
+            pt_l = jnp.where(page_tables >= 0, page_tables + l * P, -1)
+            flat_cache = write_kv(flat_cache, pad_heads(k), pad_heads(v), slots_l)
+            attn = attn_impl(
+                pad_heads(q), flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
+                positions, seq_slots, kv_lens,
+                cu_q_lens=cu_q_lens, num_seqs=num_seqs, scale=Dh ** -0.5,
+                chunk_k=pad_heads(k), chunk_v=pad_heads(v),
+            )
+            attn = attn[..., :Dh]
+            o = _mm("wo", "nhk,hkd->nd", attn)
+            if cfg.attn_bias:
+                o = o + lp["bo"]
+            if has_lora:
+                attn_flat = attn.reshape(N, cfg.num_heads * Dh)
+                o = o + apply_lora(attn_flat, lp["lora_A_wo"], lp["lora_B_wo"],
+                                   lora_indices, lora_scale)
         x = x + o
 
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_eps)

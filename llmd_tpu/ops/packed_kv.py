@@ -42,9 +42,11 @@ def pack_factor(cfg) -> int:
     """How many real KV heads share one lane row (1 = padded layout)."""
     from llmd_tpu.models.transformer import padded_head_dim
 
-    dhp = padded_head_dim(cfg.head_dim)
-    f = dhp // cfg.head_dim
-    if f > 1 and dhp == f * cfg.head_dim and cfg.num_kv_heads % f == 0:
+    if getattr(cfg, "is_mla", False):
+        return 1  # one shared latent "head" per token; nothing to pack
+    dhp = padded_head_dim(cfg.kv_cache_head_dim)
+    f = dhp // cfg.kv_cache_head_dim
+    if f > 1 and dhp == f * cfg.kv_cache_head_dim and cfg.kv_cache_heads % f == 0:
         return f
     return 1
 
