@@ -220,10 +220,10 @@ class LLMEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             # combined-head dim (2*Hk) shards over tp: K/V pairs stay together.
-            # MLA replicates instead — its single shared latent "head" (axis
-            # size 2) cannot split across tp ranks, and every head's shard
-            # needs the full latent anyway (DeepSeek TP layout: heads shard,
-            # latent KV replicates)
+            # MLA replicates instead — its pool has ONE row (the shared
+            # latent plane, axis size 1), and every head's shard needs the
+            # full latent anyway (DeepSeek TP layout: heads shard, latent KV
+            # replicates)
             spec = (P(None, None, None, None) if model_cfg.is_mla
                     else P(None, None, "tp", None))
             self.cache = jax.device_put(
@@ -391,12 +391,11 @@ class LLMEngine:
         self._unified_ring_fn = None
         self.sp_attn_backend: Optional[str] = None
         if (mesh is not None and engine_cfg.mesh.sp > 1
-                and engine_cfg.sp_ring_attention and NT % engine_cfg.mesh.sp == 0
-                # MLA should compose (absorbed attention is MQA over the
-                # latent, a GQA special case the ring handles) but is unproven
-                # against the ring program — flat-token GSPMD sharding serves
-                # sp>1 MLA prefills until a parity test lands
-                and not model_cfg.is_mla):
+                and engine_cfg.sp_ring_attention and NT % engine_cfg.mesh.sp == 0):
+            # MLA composes: absorbed attention is MQA over the latent (Hk=1,
+            # G=H in the ring's grouped layout) and the latent rides the ICI
+            # ring at rank+rope width — 4-8x fewer ring bytes than GQA KV.
+            # Parity pinned by tests/test_mla.py::test_ring_prefill_parity_under_sp.
             from llmd_tpu.ops.ring_attention import make_ring_attn_impl
 
             # ONE layout decision, passed down — sp_flash_prefill would

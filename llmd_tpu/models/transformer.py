@@ -336,7 +336,7 @@ def init_cache(cfg: ModelConfig, num_pages: int, page_size: int,
     MLA allocates a SINGLE plane — one shared [c_kv ; k_rope] row per token
     (keys and values are the same latent in absorbed attention, so a second
     plane would double KV bytes for nothing; write_kv and the XLA impl detect
-    the one-row layout by its odd combined-head count).
+    the one-row layout by HkC == 1).
 
     ``dtype`` overrides the model dtype for the pool — float8_e4m3fn halves
     decode's KV read stream (EngineConfig.kv_cache_dtype="fp8"); the Pallas
@@ -599,19 +599,9 @@ def forward_core(
             kr = rope(jnp.einsum("nd,dk->nk", h, lp["mla_wkr"])[:, None, :],
                       positions, cfg.rope_theta)[:, 0]  # [N, dr] shared key
             q_lat = jnp.einsum("nhk,hkr->nhr", q[..., :dn], lp["mla_wuk"])
-            q_eff = pad_kv(jnp.concatenate([q_lat, q_rope], axis=-1))
-            kv_eff = pad_kv(jnp.concatenate([c, kr], axis=-1)[:, None, :])
-            slots_l = jnp.where(slots >= 0, slots + l * (P * ps), -1)
-            pt_l = jnp.where(page_tables >= 0, page_tables + l * P, -1)
-            flat_cache = write_kv(flat_cache, kv_eff, kv_eff, slots_l)
-            attn = attn_impl(
-                q_eff, flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
-                positions, seq_slots, kv_lens,
-                cu_q_lens=cu_q_lens, num_seqs=num_seqs,
-                scale=(dn + dr) ** -0.5, chunk_k=kv_eff, chunk_v=kv_eff,
-            )
-            o_heads = jnp.einsum("nhr,hrv->nhv", attn[..., :r], lp["mla_wuv"])
-            o = _mm("wo", "nhv,hvd->nd", o_heads)
+            q_attn = pad_kv(jnp.concatenate([q_lat, q_rope], axis=-1))
+            k_w = v_w = pad_kv(jnp.concatenate([c, kr], axis=-1)[:, None, :])
+            scale = (dn + dr) ** -0.5
         else:
             q = _mm("wq", "nd,dhk->nhk", h)
             k = _mm("wk", "nd,dhk->nhk", h)
@@ -636,16 +626,25 @@ def forward_core(
                 k = rms_norm(k, lp["k_norm"], cfg.rms_eps)
             q = rope(q, positions, cfg.rope_theta)
             k = rope(k, positions, cfg.rope_theta)
-            # this layer's slice of the pool: slots/pages shifted by the layer offset
-            slots_l = jnp.where(slots >= 0, slots + l * (P * ps), -1)
-            pt_l = jnp.where(page_tables >= 0, page_tables + l * P, -1)
-            flat_cache = write_kv(flat_cache, pad_heads(k), pad_heads(v), slots_l)
-            attn = attn_impl(
-                pad_heads(q), flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
-                positions, seq_slots, kv_lens,
-                cu_q_lens=cu_q_lens, num_seqs=num_seqs, scale=Dh ** -0.5,
-                chunk_k=pad_heads(k), chunk_v=pad_heads(v),
-            )
+            q_attn, k_w, v_w = pad_heads(q), pad_heads(k), pad_heads(v)
+            scale = Dh ** -0.5
+        # shared paged plumbing — this layer's slice of the pool: slots/pages
+        # shifted by the layer offset, KV written, attention over the pool
+        slots_l = jnp.where(slots >= 0, slots + l * (P * ps), -1)
+        pt_l = jnp.where(page_tables >= 0, page_tables + l * P, -1)
+        flat_cache = write_kv(flat_cache, k_w, v_w, slots_l)
+        attn = attn_impl(
+            q_attn, flat_cache.reshape(Ptot, ps, HkC, Dhp), pt_l,
+            positions, seq_slots, kv_lens,
+            cu_q_lens=cu_q_lens, num_seqs=num_seqs, scale=scale,
+            chunk_k=k_w, chunk_v=v_w,
+        )
+        if cfg.is_mla:
+            # latent-weighted sum [..., :rank] re-expands per head via W_UV
+            o_heads = jnp.einsum("nhr,hrv->nhv",
+                                 attn[..., :cfg.mla_kv_lora_rank], lp["mla_wuv"])
+            o = _mm("wo", "nhv,hvd->nd", o_heads)
+        else:
             attn = attn[..., :Dh]
             o = _mm("wo", "nhk,hkd->nd", attn)
             if cfg.attn_bias:

@@ -119,7 +119,7 @@ def test_attn_backend_provenance():
     eng = _engine()
     assert eng.attn_backend == "xla_mla_absorbed"
     assert eng.kv_pack == 1  # nothing to pack: one shared latent head
-    assert eng.sp_attn_backend is None  # ring gated off for MLA (v1)
+    assert eng.sp_attn_backend is None  # no mesh on this engine → no sp ring
 
 
 def test_moe_mla_compose():
@@ -168,3 +168,51 @@ def test_explicit_pallas_on_mla_raises():
     import pytest
     with pytest.raises(ValueError, match="pallas.*MLA|MLA.*pallas"):
         _engine(attn_impl="pallas")
+
+
+def test_ring_prefill_parity_under_sp():
+    """MLA over the sp ring: absorbed attention is MQA (Hk=1, G=H in the
+    ring's grouped layout), so the shared latent rides the ICI ring at
+    rank+rope width. Greedy outputs must match the GSPMD paged path, and the
+    ring program must actually engage for the self-contained prefill."""
+    from llmd_tpu.parallel.mesh import MeshConfig
+
+    def sp_engine(ring: bool) -> LLMEngine:
+        return LLMEngine(get_model_config("tiny-mla"), EngineConfig(
+            page_size=8, num_pages=64, max_model_len=256, max_batch_size=4,
+            prefill_chunk=64, mesh=MeshConfig(dp=1, sp=2, ep=1, tp=1),
+            sp_ring_attention=ring))
+
+    prompt = list(range(7, 40))  # one fresh self-contained chunk
+    ring_eng = sp_engine(True)
+    assert ring_eng.sp_attn_backend == "ring_zigzag(sp=2)"
+    out_ring = ring_eng.generate([prompt], SamplingParams(max_tokens=6, temperature=0.0))
+    assert ring_eng.stats.n_ring_prefill_steps == 1
+    base_eng = sp_engine(False)
+    out_base = base_eng.generate([prompt], SamplingParams(max_tokens=6, temperature=0.0))
+    assert base_eng.stats.n_ring_prefill_steps == 0
+    assert out_ring == out_base
+
+
+def test_tp2_parity_with_replicated_latent_pool():
+    """TP shards heads (W_Q/W_UK/W_UV/W_O) while the single-plane latent pool
+    replicates (engine cache spec): greedy outputs on a tp=2 mesh must match
+    the unmeshed engine token-for-token."""
+    from llmd_tpu.parallel.mesh import MeshConfig
+
+    prompt = list(range(7, 40))
+    meshed = LLMEngine(get_model_config("tiny-mla"), EngineConfig(
+        page_size=8, num_pages=64, max_model_len=256, max_batch_size=4,
+        prefill_chunk=32, mesh=MeshConfig(dp=1, sp=1, ep=1, tp=2)))
+    out_tp = meshed.generate([prompt], SamplingParams(max_tokens=6, temperature=0.0))
+    out_base = _engine().generate([prompt], SamplingParams(max_tokens=6, temperature=0.0))
+    assert out_tp == out_base
+
+
+def test_fp8_kv_single_plane_smoke():
+    """fp8 pool + single-plane MLA write path (clip + convert on the shared
+    latent row): serving stays deterministic and close to the bf16 pool."""
+    prompt = list(range(10, 42))
+    a = _engine(kv_cache_dtype="fp8").generate([prompt], SamplingParams(max_tokens=5, temperature=0.0))
+    b = _engine(kv_cache_dtype="fp8").generate([prompt], SamplingParams(max_tokens=5, temperature=0.0))
+    assert a == b and len(a["req-0"]) == 5
