@@ -80,8 +80,8 @@ def _engine_cfg():
 PROMPT = "the quick brown fox jumps over the lazy dog and keeps on running far"
 
 
-async def _pd_scenario():
-    cfg = get_model_config("tiny")
+async def _pd_scenario(model: str = "tiny"):
+    cfg = get_model_config(model)
     # identical seed → identical weights on P, D, and the aggregated control engine
     prefill = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
                            port=0, kv_transfer_port=0)
@@ -163,6 +163,13 @@ async def _pd_scenario():
 
 def test_pd_disaggregation_e2e():
     run_async(_pd_scenario())
+
+
+def test_pd_disaggregation_e2e_mla():
+    """P/D with MLA latent pages: the transferred KV is the single-plane
+    latent pool — 4x fewer bytes per block than the GQA equivalent — and the
+    decode side must reproduce the aggregated control output exactly."""
+    run_async(_pd_scenario("tiny-mla"))
 
 
 async def _stale_pull_scenario():

@@ -89,10 +89,13 @@ def _mk_engine(tiny_cfg, tmpdir=None, **kw):
     return LLMEngine(tiny_cfg, EngineConfig(**defaults))
 
 
-def test_engine_offload_reload_correctness(tiny_cfg):
+@pytest.mark.parametrize("model", ["tiny", "tiny-mla"])
+def test_engine_offload_reload_correctness(model):
     """Evict prompt A's KV to CPU under pressure; rerunning A must reload (not
-    recompute) and produce byte-identical greedy output."""
-    eng = _mk_engine(tiny_cfg)
+    recompute) and produce byte-identical greedy output. Runs for GQA and for
+    MLA, whose single-plane latent pages round-trip the tier at 4x fewer
+    bytes per block."""
+    eng = _mk_engine(get_model_config(model))
     prompt_a = list(range(1, 49))  # 6 pages of 8
     prompt_b = list(range(100, 170))  # large enough to evict A from the 12-page pool
     greedy = SamplingParams(max_tokens=6, temperature=0.0)
@@ -131,3 +134,4 @@ def test_engine_offload_fs_tier(tiny_cfg, tmp_path):
                 got.extend(o.new_token_ids)
     assert got == cold
     assert eng.stats.total_offload_loads > 0
+
