@@ -27,3 +27,5 @@ def test_dryrun_16_devices():
     # 16 devices must light up every axis at once: dp·sp·ep·tp = 16 with sp>1
     assert "sp=2" in out, out
     assert "16 devices" in out, out
+    # the MLA x MoE variant (wide-EP north-star stack) must run on the mesh
+    assert "tiny-mla-moe" in out and "xla_mla_absorbed" in out, out
