@@ -171,15 +171,29 @@ def main() -> None:
                 "unit": "tok/s", "vs_baseline": None,
                 "skipped": "device-unavailable", "error": err,
             }
-            flag_default = args.model is None \
+            flag_default = not tiny and args.model is None \
                 and not any([args.batch, args.decode_steps, args.isl, args.osl,
                              args.layer_unroll]) \
+                and os.environ.get("LLMD_LAYER_UNROLL") in (None, "", "1") \
                 and args.quantize == "default" and args.kv_dtype == "default" \
                 and args.kv_layout == "auto"
             if flag_default:
                 try:
-                    camp = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                        "BENCH_CAMPAIGN_r05.json")
+                    import glob as _glob
+                    import re as _re
+
+                    # newest CANONICAL campaign artifact (round-agnostic —
+                    # a stale filename constant would re-emit a prior round's
+                    # number as this round's). Suffixed variants like
+                    # *_preclamp.json are lever-attribution records of STALE
+                    # code states; the strict pattern keeps them out.
+                    camps = sorted(
+                        p for p in _glob.glob(os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_CAMPAIGN_r*.json"))
+                        if _re.fullmatch(r"BENCH_CAMPAIGN_r\d+\.json",
+                                         os.path.basename(p)))
+                    camp = camps[-1] if camps else ""
                     with open(camp) as f:
                         data = json.load(f)
                     best = data.get("best_serving") or {}
@@ -190,9 +204,9 @@ def main() -> None:
                         out = dict(row)
                         out.pop("wall_total_s", None)
                         out["source"] = (
-                            f"harvested on-chip this round (campaign point "
-                            f"{row['point']}); live device unavailable at "
-                            f"bench time: {err}")
+                            f"harvested on-chip from {os.path.basename(camp)} "
+                            f"(campaign point {row['point']}); live device "
+                            f"unavailable at bench time: {err}")
                 except (OSError, json.JSONDecodeError, KeyError):
                     pass
             print(json.dumps(out))

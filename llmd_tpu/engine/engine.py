@@ -962,6 +962,24 @@ class LLMEngine:
             seq.pages.append(pid)
         return True
 
+    def _finish_if_outgrew_pool(self, seq: Sequence) -> None:
+        """Termination backstop for a RUNNING seq that can never be scheduled
+        again: its next token needs more pages than the rank's ENTIRE pool
+        (generation outgrew the pool with nothing left to evict). Without
+        this the step loop spins forever — plan empty, has_work() true —
+        because the admission-path 'can never fit → finish length' backstop
+        (see _try_admit_rank) only reaches seqs that went back to the waitq.
+        Mirrors its semantics: finish with 'length', deliver what we have."""
+        ps = self.cfg.page_size
+        if (len(seq.token_ids) + ps - 1) // ps <= self.allocs[seq.rank].num_pages:
+            return  # transient pressure: another seq's retirement will free pages
+        self._retire(seq, "length")
+        self._outputs.append(EngineOutput(
+            request_id=seq.request_id, new_token_ids=[], finished=True,
+            finish_reason="length", num_cached_prompt_tokens=seq.num_cached_prompt,
+            prompt_len=seq.prompt_len,
+        ))
+
     def _preempt_one(self, rank: int = 0,
                      exclude: Optional[Sequence] = None) -> bool:
         """Evict the rank's most recently arrived running seq back to waiting
@@ -1085,6 +1103,7 @@ class LLMEngine:
                 continue
             if not self._ensure_pages(s, len(s.token_ids)):
                 if not self._preempt_one(s.rank, exclude=s) or s.slot < 0:
+                    self._finish_if_outgrew_pool(s)
                     continue
                 if not self._ensure_pages(s, len(s.token_ids)):
                     continue
@@ -1101,6 +1120,7 @@ class LLMEngine:
                 continue
             if not self._ensure_pages(s, s.num_computed + n):
                 if not self._preempt_one(s.rank, exclude=s) or s.slot < 0:
+                    self._finish_if_outgrew_pool(s)
                     continue
                 if not self._ensure_pages(s, s.num_computed + n):
                     continue

@@ -232,3 +232,23 @@ def test_no_page_leak_under_preemption_churn(engine_factory):
                 f"step {steps}: page {pid} refs={info.refs} but owned by "
                 f"{held} seqs (leak)")
     assert eng.stats.total_preemptions > 0  # churn actually happened
+
+
+def test_solo_seq_outgrowing_pool_finishes_with_length(engine_factory):
+    """A lone sequence whose generation outgrows the ENTIRE pool must finish
+    with 'length' (delivering what fits), not spin forever: with no eviction
+    victim and no waitq trip, the admission-path can-never-fit backstop is
+    unreachable, so the scheduler's own backstop has to fire."""
+    eng = engine_factory(num_pages=10, max_batch_size=4)  # 80-slot pool
+    eng.add_request("r", list(range(1, 61)),
+                    SamplingParams(max_tokens=30, temperature=0.0, ignore_eos=True))
+    got, finished, reason, steps = [], False, None, 0
+    while eng.has_work():
+        for o in eng.step():
+            got.extend(o.new_token_ids)
+            if o.finished:
+                finished, reason = True, o.finish_reason
+        steps += 1
+        assert steps < 300, "no forward progress (solo-outgrowth livelock)"
+    assert finished and reason == "length"
+    assert len(got) >= 20  # everything the pool could hold was delivered

@@ -101,7 +101,10 @@ def test_prefix_cache_reuse_and_correctness():
     b = eng.generate([shared + [90, 91]], SamplingParams(max_tokens=4, temperature=0.0))
     fresh = _engine().generate([shared + [90, 91]], SamplingParams(max_tokens=4, temperature=0.0))
     assert b["req-0"] == fresh["req-0"]  # reused latent pages give same result
-    assert a["req-0"] != b["req-0"] or True  # sanity: different suffixes ran
+    # different suffixes must produce different continuations — a cache
+    # addressing bug returning A's continuation for B would pass the reuse
+    # check above while being completely wrong
+    assert a["req-0"] != b["req-0"]
 
 
 def test_preemption_recompute_continues():
