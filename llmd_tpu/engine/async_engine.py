@@ -62,6 +62,7 @@ class AsyncLLMEngine:
         lora_id: Optional[str] = None,
         rank: int = 0,
         mm_items=None,
+        trace_ctx=None,
     ) -> AsyncIterator[EngineOutput]:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
@@ -69,7 +70,8 @@ class AsyncLLMEngine:
         try:
             with self._lock:
                 self.engine.add_request(request_id, token_ids, sampling, lora_id,
-                                        rank=rank, mm_items=mm_items)
+                                        rank=rank, mm_items=mm_items,
+                                        trace_ctx=trace_ctx)
         except ValueError:
             self._streams.pop(request_id, None)
             raise

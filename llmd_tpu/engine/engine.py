@@ -38,6 +38,8 @@ from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.kv_manager import PageAllocator, Sequence
 from llmd_tpu.engine.sampling import sample_tokens
 from llmd_tpu.models.config import ModelConfig
+from llmd_tpu.obs.metrics import Registry, register_engine_metrics
+from llmd_tpu.obs.tracing import global_tracer
 from llmd_tpu.models.transformer import (
     forward_core,
     init_cache,
@@ -133,6 +135,15 @@ class LLMEngine:
         ]
         self.alloc = self.allocs[0]
         self.slots_per_rank = engine_cfg.max_batch_size // R
+        # Shared metrics registry: the engine increments step-loop families
+        # here and EngineServer renders them from its /metrics handler (in
+        # wide-EP every frontend scrapes this same registry).
+        self.registry = Registry()
+        self.metrics = register_engine_metrics(self.registry)
+        self.metrics.cache_config.labels(
+            block_size=engine_cfg.page_size,
+            num_gpu_blocks=engine_cfg.num_pages).set(1)
+        self.tracer = global_tracer()
         self.offload = None
         if engine_cfg.cpu_offload_pages > 0 or engine_cfg.offload_fs_path:
             from llmd_tpu.kv.fs_backend import FSKVBackend
@@ -144,8 +155,14 @@ class LLMEngine:
                 staging_blocks=engine_cfg.offload_staging_blocks,
                 fs_backend=fs, event_sink=event_sink,
                 pages_per_layer=engine_cfg.num_pages,
+                metrics=self.metrics,
             )
             self.alloc.evict_hook = lambda h, pid: self.offload.on_evict(self.cache, h, pid)
+            store = self.offload.store
+            self.metrics.offload_saves.set_function(lambda: store.saves)
+            self.metrics.offload_loads.set_function(lambda: store.loads)
+            self.metrics.offload_demotions.set_function(lambda: store.demotions)
+            self.metrics.offload_cpu_blocks.set_function(lambda: len(store))
         # K5: out-of-tree connector — external engine behind the native tiers
         self.kv_connector = None
         self._connector_pool = None
@@ -711,6 +728,7 @@ class LLMEngine:
         lora_id: Optional[str] = None,
         rank: int = 0,
         mm_items: Optional[list[tuple[bytes, np.ndarray]]] = None,
+        trace_ctx: Optional[object] = None,
     ) -> None:
         sampling = sampling or SamplingParams()
         if not token_ids:
@@ -747,7 +765,7 @@ class LLMEngine:
             request_id=request_id, token_ids=list(token_ids), prompt_len=len(token_ids),
             max_tokens=sampling.max_tokens, sampling=sampling, lora_id=lora_id,
             lora_key=self._lora_hash_key(lora_id), arrival_time=time.monotonic(),
-            rank=rank, mm_items=mm_items,
+            rank=rank, mm_items=mm_items, trace_ctx=trace_ctx,
         )
         # pod state as a router would have observed it at arrival — joined with
         # the observed latencies at retirement into one predictor training row
@@ -958,6 +976,7 @@ class LLMEngine:
         while len(seq.pages) < need:
             pid = alloc.allocate()
             if pid is None:
+                self.metrics.kv_exhaustion.inc()
                 return False
             seq.pages.append(pid)
         return True
@@ -1011,6 +1030,7 @@ class LLMEngine:
         victim.num_cached_prompt = 0
         self.waitq[rank].appendleft(victim)
         self.stats.total_preemptions += 1
+        self.metrics.preemptions.inc()
         return True
 
     # --------------------------------------------------------------- stepping
@@ -1034,9 +1054,35 @@ class LLMEngine:
         self.stats.num_running = sum(1 for s in self.running if s is not None)
         self.stats.kv_utilization = (
             sum(a.num_active for a in self.allocs) / max(1, self.cfg.num_pages))
+        m = self.metrics
+        m.requests_waiting.set(self.stats.num_waiting)
+        m.requests_running.set(self.stats.num_running)
+        m.kv_usage.set(self.stats.kv_utilization)
+        m.batch_occupancy.labels(kind="running").observe(self.stats.num_running)
+        m.batch_occupancy.labels(kind="waiting").observe(self.stats.num_waiting)
         if self._eplb is not None:
             self._eplb_tick()
         return self._outputs
+
+    def _emit_step_spans(self, phase: str, seqs: list[Sequence],
+                         start_ns: int, batch_size: int, n_tokens: int) -> None:
+        """Emit one `engine.step` child span per traced sequence in the batch,
+        parented on the request span context carried in via add_request — the
+        engine's step work shows up nested under `engine.generate`."""
+        tracer = self.tracer
+        if tracer is None:
+            return
+        for s in seqs:
+            ctx = s.trace_ctx
+            if ctx is None or not getattr(ctx, "sampled", False):
+                continue
+            span = tracer.start_span(
+                "engine.step", parent=ctx,
+                **{"llm_d.phase": phase, "llm_d.batch_size": batch_size,
+                   "llm_d.step_tokens": n_tokens,
+                   "llm_d.request_id": s.request_id})
+            span.start_ns = start_ns
+            span.end()
 
     def _offload_drain(self) -> None:
         """Keep the plain free list above the watermark by batch-demoting the oldest
@@ -1078,6 +1124,7 @@ class LLMEngine:
         """Pack decode tokens + prefill chunks (across sequences) into the flat
         token budget and run ONE compiled step."""
         t0 = time.perf_counter()
+        t0_ns = time.time_ns()
         NT = self.cfg.batched_tokens
         B = self.cfg.max_batch_size
         R = self.num_ranks
@@ -1237,6 +1284,15 @@ class LLMEngine:
         st.time_postprocess += t3 - t2
         st.time_prefill_steps += t3 - t0
         st.n_unified_steps += 1
+        n_dec = sum(1 for _, _, d in plan if d)
+        n_pre = sum(n for _, n, d in plan if not d)
+        if n_dec:
+            self.metrics.decode_tokens.inc(n_dec)
+        if n_pre:
+            self.metrics.prefill_tokens.inc(n_pre)
+        self.metrics.step_duration.labels(phase="unified").observe(t3 - t0)
+        self._emit_step_spans("unified", [s for s, _, _ in plan], t0_ns,
+                              len(plan), n_pre + n_dec)
 
     def _step_decode(self) -> None:
         """Fused multi-step decode with pipelined dispatch.
@@ -1363,6 +1419,8 @@ class LLMEngine:
         )
         self.stats.time_decode_steps += time.perf_counter() - wall_start
         self.stats.n_decode_dispatches += 1
+        self.metrics.step_duration.labels(phase="decode_dispatch").observe(
+            time.perf_counter() - wall_start)
         # Start the device->host copy of everything _decode_process will read.
         # Remote/tunneled runtimes defer execution until a result is demanded;
         # the async-copy hint makes the call run (and its tokens land on the
@@ -1381,6 +1439,8 @@ class LLMEngine:
     def _decode_process(self, rec: dict) -> None:
         """Read one in-flight decode call's results and apply them to host state."""
         t1 = time.perf_counter()
+        t1_ns = time.time_ns()
+        n_tokens = 0
         if self._eplb is not None:
             self._eplb_record(rec["cnt"])
         toks_out = np.asarray(rec["toks_out"])  # [k, B] (device sync point)
@@ -1405,6 +1465,7 @@ class LLMEngine:
             s.maybe_commit_blocks(self.allocs[s.rank])
             self.stats.total_decode_tokens += len(kept)
             self.stats.decode_tokens_fused += len(kept)
+            n_tokens += len(kept)
             if finished:
                 self._retire(s, reason)
             self._outputs.append(EngineOutput(
@@ -1419,6 +1480,11 @@ class LLMEngine:
         st.time_postprocess += t3 - t2
         st.time_decode_steps += t3 - t1
         st.n_decode_calls += 1
+        if n_tokens:
+            self.metrics.decode_tokens.inc(n_tokens)
+        self.metrics.step_duration.labels(phase="decode_process").observe(t3 - t1)
+        self._emit_step_spans("decode", [s for s, _ in rec["rows"]], t1_ns,
+                              len(rec["rows"]), n_tokens)
 
     def _retire(self, seq: Sequence, reason: Optional[str]) -> None:
         """Shared retirement path: free slot + pages, drop from the live map."""

@@ -246,6 +246,9 @@ class Sequence:
     # keys) so two prompts with identical tokens but different media never share
     # cache entries.
     mm_items: list = field(default_factory=list)
+    # obs.tracing.SpanContext of the request span (engine.generate) when the
+    # request arrived traced — engine step spans parent onto it
+    trace_ctx: Optional[object] = None
 
     @property
     def num_generated(self) -> int:

@@ -131,6 +131,21 @@ class EngineServer:
         from llmd_tpu.obs.tracing import global_tracer
 
         self.tracer = global_tracer()  # engine hop joins the EPP trace
+        # Frontend-owned metric families live in a per-server registry (each
+        # wide-EP rank frontend counts its own requests/transfers); engine-
+        # loop families live in engine.registry. /metrics renders both.
+        from llmd_tpu.obs.metrics import Registry, register_engine_server_metrics
+
+        self.registry = Registry()
+        self.server_metrics = register_engine_server_metrics(self.registry)
+        self.server_metrics.requests.set_function(lambda: self.request_count)
+        for key in ("injected_blocks", "pull_failures"):
+            self.server_metrics.transfer[key].set_function(
+                lambda k=key: self.transfer_stats[k])
+        for key in ("exports", "pulls", "notifies", "expired"):
+            self.server_metrics.transfer[key].set_function(
+                lambda k=key: self.transfer_source.stats.get(k, 0)
+                if self.transfer_source is not None else 0)
 
     # -- KV events ---------------------------------------------------------
     def _on_kv_events(self, events: list[KVEvent]) -> None:
@@ -422,7 +437,8 @@ class EngineServer:
 
         try:
             gen = self.async_engine.generate(rid, token_ids, sampling, lora_id,
-                                             rank=self.rank, mm_items=mm_items)
+                                             rank=self.rank, mm_items=mm_items,
+                                             trace_ctx=span.context)
             if not stream:
                 out_ids: list[int] = []
                 cached = 0
@@ -727,45 +743,29 @@ class EngineServer:
         return web.json_response({"prompt_token_ids": self._tokenize_body(body)})
 
     async def _metrics(self, request: web.Request):
+        # Gauges mirror engine.stats at scrape time; counters/histograms are
+        # incremented live inside the step loop. The whole exposition renders
+        # through Registry.expose() — the one code path shared with the
+        # router — so label values (LoRA adapter names especially) are always
+        # escaped per the text format spec.
+        em = self.engine.metrics
         s = self.engine.stats
-        cfg = self.engine.cfg
-        lines = [
-            f"vllm:num_requests_waiting {s.num_waiting}",
-            f"vllm:num_requests_running {s.num_running}",
-            f"vllm:kv_cache_usage_perc {s.kv_utilization:.6f}",
-            f'vllm:cache_config_info{{block_size="{cfg.page_size}",num_gpu_blocks="{cfg.num_pages}"}} 1',
-            # native duplicates
-            f"llmd_tpu:prefill_tokens_total {s.total_prefill_tokens}",
-            f"llmd_tpu:decode_tokens_total {s.total_decode_tokens}",
-            f"llmd_tpu:preemptions_total {s.total_preemptions}",
-            f"llmd_tpu:requests_total {self.request_count}",
-        ]
+        em.requests_waiting.set(s.num_waiting)
+        em.requests_running.set(s.num_running)
+        em.kv_usage.set(s.kv_utilization)
+        # counters the step loop doesn't own (recompute path) stay derived
+        # from stats via the registry increments at their emit sites; the
+        # lora info gauge is rebuilt each scrape (its labels ARE the data)
         if self.engine.lora_registry is not None:
             info = self.engine.lora_registry.metrics_info()
-            lines.append(
-                'vllm:lora_requests_info{{max_lora="{max_lora}",'
-                'running_lora_adapters="{running_lora_adapters}",'
-                'waiting_lora_adapters="{waiting_lora_adapters}"}} 1'.format(**info)
-            )
-        if self.transfer_source is not None:
-            ts = self.transfer_source.stats
-            lines += [
-                f"llmd_tpu:kv_transfer_exports_total {ts['exports']}",
-                f"llmd_tpu:kv_transfer_pulls_total {ts['pulls']}",
-                f"llmd_tpu:kv_transfer_notifies_total {ts['notifies']}",
-                f"llmd_tpu:kv_transfer_expired_total {ts['expired']}",
-                f"llmd_tpu:kv_transfer_injected_blocks_total {self.transfer_stats['injected_blocks']}",
-                f"llmd_tpu:kv_transfer_pull_failures_total {self.transfer_stats['pull_failures']}",
-            ]
-        if self.engine.offload is not None:
-            st = self.engine.offload.store
-            lines += [
-                f"llmd_tpu:offload_saves_total {st.saves}",
-                f"llmd_tpu:offload_loads_total {st.loads}",
-                f"llmd_tpu:offload_demotions_total {st.demotions}",
-                f"llmd_tpu:offload_cpu_blocks {len(st)}",
-            ]
-        return web.Response(text="\n".join(lines) + "\n")
+            em.lora_info.clear()
+            em.lora_info.labels(
+                max_lora=info["max_lora"],
+                running_lora_adapters=info["running_lora_adapters"],
+                waiting_lora_adapters=info["waiting_lora_adapters"],
+            ).set(1)
+        return web.Response(
+            text=self.engine.registry.expose() + self.registry.expose())
 
     async def _health(self, request: web.Request):
         return web.json_response({"status": "ok"})

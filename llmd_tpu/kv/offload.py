@@ -41,10 +41,12 @@ class CPUOffloadStore:
         capacity_blocks: int,
         fs_backend: Optional[FSKVBackend] = None,
         event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
+        metrics=None,
     ) -> None:
         self.capacity = capacity_blocks
         self.fs = fs_backend
         self.event_sink = event_sink
+        self.metrics = metrics  # EngineMetrics (obs.metrics) or None
         self._blocks: OrderedDict[int, np.ndarray] = OrderedDict()
         self._pending_fs: dict[int, object] = {}  # hash → in-flight demotion future
         self.saves = 0
@@ -61,12 +63,17 @@ class CPUOffloadStore:
             return
         self._blocks[block_hash] = array
         self.saves += 1
+        if self.metrics is not None:
+            self.metrics.offload_transfer_bytes.labels(
+                direction="save").observe(array.nbytes)
         events: list[KVEvent] = [BlockStored(
             block_hashes=[block_hash], parent_block_hash=None, token_ids=[],
             block_size=0, medium=MEDIUM_CPU,
         )]
         while len(self._blocks) > self.capacity:
             old_hash, old_arr = self._blocks.popitem(last=False)
+            if self.metrics is not None:
+                self.metrics.offload_evictions.inc()
             events.append(BlockRemoved(block_hashes=[old_hash], medium=MEDIUM_CPU))
             if self.fs is not None:
                 # async demotion: keeps the engine step loop off the disk; the popped
@@ -88,6 +95,7 @@ class CPUOffloadStore:
         if arr is not None:
             self._blocks.move_to_end(block_hash)
             self.loads += 1
+            self._record_hit(arr)
             return arr
         if self.fs is not None:
             fut = self._pending_fs.get(block_hash)
@@ -95,12 +103,25 @@ class CPUOffloadStore:
                 try:
                     fut.result()  # wait out an in-flight demotion write
                 except Exception:
+                    self._record_miss()
                     return None
             arr = self.fs.get(block_hash)
             if arr is not None:
                 self.loads += 1
+                self._record_hit(arr)
                 return arr
+        self._record_miss()
         return None
+
+    def _record_hit(self, arr: np.ndarray) -> None:
+        if self.metrics is not None:
+            self.metrics.offload_hits.inc()
+            self.metrics.offload_transfer_bytes.labels(
+                direction="load").observe(arr.nbytes)
+
+    def _record_miss(self) -> None:
+        if self.metrics is not None:
+            self.metrics.offload_misses.inc()
 
     def contains(self, block_hash: int) -> bool:
         if block_hash in self._blocks:
@@ -129,8 +150,10 @@ class KVOffloadConnector:
         fs_backend: Optional[FSKVBackend] = None,
         event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
         pages_per_layer: Optional[int] = None,
+        metrics=None,
     ) -> None:
-        self.store = CPUOffloadStore(num_cpu_chunks, fs_backend, event_sink)
+        self.store = CPUOffloadStore(num_cpu_chunks, fs_backend, event_sink,
+                                     metrics=metrics)
         self.staging_blocks = max(1, staging_blocks)
         # cache is the flat layer-folded pool [L*P, ps, 2Hk, Dhp]; P is needed to
         # gather one logical page's rows across layers. None = single-layer pool.

@@ -1,0 +1,551 @@
+"""Dependency-free Prometheus-style metrics registry.
+
+The reference system leans on the upstream prometheus client; this repo is
+a zero-dependency reproduction, so the text-exposition contract
+(`# HELP` / `# TYPE` headers, cumulative `_bucket`/`_sum`/`_count`
+histogram series, label-value escaping per the Prometheus text format
+spec) is implemented here directly.
+
+Design notes:
+
+* Thread-safe. The engine step loop runs on a dedicated thread
+  (AsyncLLMEngine) while the aiohttp handlers scrape from the asyncio
+  event loop; every mutation and the exposition walk take the registry
+  lock.
+* Families are idempotent: registering the same (name, type) twice
+  returns the existing family, so the engine and its server(s) can share
+  one registry without coordination. A type mismatch raises.
+* `set_function` attaches a scrape-time callback to an unlabeled
+  counter/gauge. This is how legacy counter dicts (scheduler.metrics,
+  FlowController.metrics, transfer_stats) surface without dual
+  bookkeeping: declare the family once, point it at the dict.
+* `Registry.collect()` yields (name, labels, value) samples and
+  `Registry.expose()` renders the text format; both servers' `/metrics`
+  handlers render through this one code path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Summary",
+    "Registry",
+    "escape_label_value",
+    "escape_help",
+    "EngineMetrics",
+    "EngineServerMetrics",
+    "RouterMetrics",
+    "register_engine_metrics",
+    "register_engine_server_metrics",
+    "register_router_metrics",
+]
+
+# Default latency buckets (seconds) — tuned for a TPU serving step loop
+# where unified steps land in the 1-500 ms range.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def escape_label_value(value: object) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote, and newline must be escaped inside the
+    double-quoted label value."""
+    return (str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (but not quotes)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _render_labels(names: Sequence[str], values: Sequence[object],
+                   extra: Tuple[Tuple[str, str], ...] = ()) -> str:
+    pairs = [f'{n}="{escape_label_value(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{escape_label_value(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt(v: float) -> str:
+    # Integers render without a trailing .0 (matches prometheus_client and
+    # keeps byte-for-byte parity with the previous hand-rolled exposition).
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Family:
+    """Base class: holds per-label-set children keyed by label values."""
+
+    typ = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.RLock):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._fn: Optional[Callable[[], float]] = None
+
+    # -- child management -------------------------------------------------
+    def labels(self, **kv):
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kv)} != declared "
+                f"{sorted(self.labelnames)}")
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child()
+                self._children[key] = child
+            return child
+
+    def clear(self) -> None:
+        """Drop all children (used for scrape-time-refreshed info gauges)."""
+        with self._lock:
+            self._children.clear()
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        """Attach a scrape-time value callback (unlabeled families only)."""
+        if self.labelnames:
+            raise ValueError(f"{self.name}: set_function on labeled family")
+        self._fn = fn
+
+    def _default(self):
+        """The implicit child for unlabeled families."""
+        if self.labelnames:
+            raise ValueError(f"{self.name}: family has labels; use .labels()")
+        key = ()
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(key, self._new_child())
+        return child
+
+    def _new_child(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    # -- exposition -------------------------------------------------------
+    def samples(self) -> Iterable[Tuple[str, str, float]]:
+        """Yield (suffix, rendered-labels, value) triples."""
+        if self._fn is not None:
+            yield "", "", float(self._fn())
+            return
+        for key, child in self._children.items():
+            yield from self._child_samples(key, child)
+
+    def _child_samples(self, key, child):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+class _Value:
+    __slots__ = ("v",)
+
+    def __init__(self):
+        self.v = 0.0
+
+
+class Counter(_Family):
+    typ = "counter"
+
+    def _new_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        return self._default().value
+
+    def _child_samples(self, key, child):
+        yield "", _render_labels(self.labelnames, key), child.value
+
+
+class _CounterChild:
+    def __init__(self, lock):
+        self._lock = lock
+        self._v = _Value()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self._v.v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v.v
+
+
+class Gauge(_Family):
+    typ = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().inc(-amount)
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            return float(self._fn())
+        return self._default().value
+
+    def _child_samples(self, key, child):
+        yield "", _render_labels(self.labelnames, key), child.value
+
+
+class _GaugeChild:
+    def __init__(self, lock):
+        self._lock = lock
+        self._v = _Value()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._v.v = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._v.v += amount
+
+    @property
+    def value(self) -> float:
+        return self._v.v
+
+
+class Histogram(_Family):
+    """Cumulative-bucket histogram: `_bucket{le=...}` series are cumulative
+    counts, closed by `le="+Inf"`, plus `_sum` and `_count`."""
+
+    typ = "histogram"
+
+    def __init__(self, name, help, labelnames, lock,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames, lock)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+
+    def _new_child(self):
+        return _HistogramChild(self.buckets, self._lock)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    def _child_samples(self, key, child):
+        cum = 0
+        for b, c in zip(self.buckets, child.counts):
+            cum += c
+            yield ("_bucket",
+                   _render_labels(self.labelnames, key, (("le", _fmt(b)),)),
+                   cum)
+        yield ("_bucket",
+               _render_labels(self.labelnames, key, (("le", "+Inf"),)),
+               child.count)
+        yield "_sum", _render_labels(self.labelnames, key), child.sum
+        yield "_count", _render_labels(self.labelnames, key), child.count
+
+
+class _HistogramChild:
+    def __init__(self, buckets, lock):
+        self._buckets = buckets
+        self._lock = lock
+        self.counts = [0] * len(buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self.sum += v
+            self.count += 1
+            for i, b in enumerate(self._buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    break
+
+
+class Summary(_Family):
+    """sum + count only (no quantiles) — enough for rate()-based means."""
+
+    typ = "summary"
+
+    def _new_child(self):
+        return _SummaryChild(self._lock)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    @property
+    def sum(self) -> float:
+        return self._default().sum
+
+    @property
+    def count(self) -> int:
+        return self._default().count
+
+    def _child_samples(self, key, child):
+        yield "_sum", _render_labels(self.labelnames, key), child.sum
+        yield "_count", _render_labels(self.labelnames, key), child.count
+
+
+class _SummaryChild:
+    def __init__(self, lock):
+        self._lock = lock
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self.sum += float(value)
+            self.count += 1
+
+
+class Registry:
+    """A named set of metric families with a single text formatter."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._families: Dict[str, _Family] = {}
+
+    def _register(self, cls, name, help, labelnames, **kw) -> _Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if not isinstance(fam, cls):
+                    raise ValueError(
+                        f"{name}: already registered as {fam.typ}")
+                return fam
+            fam = cls(name, help, labelnames, self._lock, **kw)
+            if not fam.labelnames:
+                fam._default()  # expose 0 immediately (contract presence)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help, labelnames,
+                              buckets=buckets)
+
+    def summary(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Summary:
+        return self._register(Summary, name, help, labelnames)
+
+    def families(self) -> List[str]:
+        """Registered family base names (for the metrics linter)."""
+        with self._lock:
+            return sorted(self._families)
+
+    def get(self, name: str) -> Optional[_Family]:
+        return self._families.get(name)
+
+    def collect(self) -> List[Tuple[str, str, float]]:
+        """Flat (full_name, rendered_labels, value) sample list."""
+        out = []
+        with self._lock:
+            for name, fam in self._families.items():
+                for suffix, labels, value in fam.samples():
+                    out.append((name + suffix, labels, value))
+        return out
+
+    def expose(self) -> str:
+        """Render the Prometheus text exposition format."""
+        lines: List[str] = []
+        with self._lock:
+            for name, fam in self._families.items():
+                if fam.help:
+                    lines.append(f"# HELP {name} {escape_help(fam.help)}")
+                lines.append(f"# TYPE {name} {fam.typ}")
+                for suffix, labels, value in fam.samples():
+                    lines.append(f"{name}{suffix}{labels} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Family declarations. All static families live here so tools/lint_metrics.py
+# can enumerate what the stack emits by building throwaway registries —
+# scrape-time callbacks get attached later by the owning component.
+# ---------------------------------------------------------------------------
+
+
+class EngineMetrics:
+    """Families owned by LLMEngine (incremented inside the step loop)."""
+
+    def __init__(self, reg: Registry):
+        self.registry = reg
+        self.step_duration = reg.histogram(
+            "llmd_tpu:engine_step_duration_seconds",
+            "Engine step wall time by phase "
+            "(unified, decode_dispatch, decode_process)",
+            labelnames=("phase",))
+        self.batch_occupancy = reg.histogram(
+            "llmd_tpu:engine_batch_occupancy",
+            "Running/waiting sequence counts sampled once per engine step",
+            labelnames=("kind",),
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self.prefill_tokens = reg.counter(
+            "llmd_tpu:prefill_tokens_total",
+            "Prompt tokens computed by the engine")
+        self.decode_tokens = reg.counter(
+            "llmd_tpu:decode_tokens_total",
+            "Decode tokens generated by the engine")
+        self.preemptions = reg.counter(
+            "llmd_tpu:preemptions_total",
+            "Sequences preempted (recompute-on-readmit)")
+        self.kv_exhaustion = reg.counter(
+            "llmd_tpu:kv_block_exhaustion_total",
+            "KV page allocations that failed because the pool was exhausted")
+        self.requests_waiting = reg.gauge(
+            "vllm:num_requests_waiting",
+            "Sequences in the engine waiting queue")
+        self.requests_running = reg.gauge(
+            "vllm:num_requests_running",
+            "Sequences actively running in the engine batch")
+        self.kv_usage = reg.gauge(
+            "vllm:kv_cache_usage_perc",
+            "KV cache page utilization (0..1)")
+        self.cache_config = reg.gauge(
+            "vllm:cache_config_info",
+            "Static KV cache configuration",
+            labelnames=("block_size", "num_gpu_blocks"))
+        self.lora_info = reg.gauge(
+            "vllm:lora_requests_info",
+            "Running/waiting LoRA adapters (refreshed at scrape time)",
+            labelnames=("max_lora", "running_lora_adapters",
+                        "waiting_lora_adapters"))
+        # KV offload tier: hit/miss/evict incremented inside CPUOffloadStore;
+        # saves/loads/demotions/cpu_blocks attach callbacks onto the legacy
+        # store counters when offload is enabled.
+        self.offload_hits = reg.counter(
+            "llmd_tpu:offload_hits_total",
+            "CPU offload store lookups that found the block")
+        self.offload_misses = reg.counter(
+            "llmd_tpu:offload_misses_total",
+            "CPU offload store lookups that missed")
+        self.offload_evictions = reg.counter(
+            "llmd_tpu:offload_evictions_total",
+            "Blocks evicted from the CPU offload store (LRU)")
+        self.offload_transfer_bytes = reg.histogram(
+            "llmd_tpu:offload_transfer_bytes",
+            "Bytes moved per offload transfer, by direction (save|load)",
+            labelnames=("direction",),
+            buckets=(1024, 16384, 65536, 262144, 1048576, 4194304,
+                     16777216, 67108864))
+        self.offload_saves = reg.counter(
+            "llmd_tpu:offload_saves_total",
+            "Blocks saved into the CPU offload store")
+        self.offload_loads = reg.counter(
+            "llmd_tpu:offload_loads_total",
+            "Blocks loaded back from the CPU offload store")
+        self.offload_demotions = reg.counter(
+            "llmd_tpu:offload_demotions_total",
+            "Blocks demoted from the CPU store to the filesystem tier")
+        self.offload_cpu_blocks = reg.gauge(
+            "llmd_tpu:offload_cpu_blocks",
+            "Blocks currently resident in the CPU offload store")
+
+
+class EngineServerMetrics:
+    """Families owned by EngineServer (per-frontend in wide-EP mode)."""
+
+    def __init__(self, reg: Registry):
+        self.registry = reg
+        self.requests = reg.counter(
+            "llmd_tpu:requests_total",
+            "Generation requests accepted by this frontend")
+        self.transfer = {
+            key: reg.counter(
+                f"llmd_tpu:kv_transfer_{key}_total",
+                f"Disaggregated KV transfer: {key}")
+            for key in ("exports", "pulls", "notifies", "expired",
+                        "injected_blocks", "pull_failures")
+        }
+
+
+class RouterMetrics:
+    """Families owned by RouterServer (EPP-side contract)."""
+
+    def __init__(self, reg: Registry):
+        self.registry = reg
+        self.requests = reg.counter(
+            "llm_d_epp_requests_total", "Requests received by the router")
+        self.responses = reg.counter(
+            "llm_d_epp_responses_total", "Successful responses")
+        self.errors = reg.counter(
+            "llm_d_epp_errors_total", "Errored requests")
+        self.scheduled = reg.counter(
+            "llm_d_epp_scheduled_total", "Scheduling decisions made")
+        self.rejected = reg.counter(
+            "llm_d_epp_rejected_total", "Requests the scheduler rejected")
+        self.pd_splits = reg.counter(
+            "llm_d_epp_pd_splits_total", "Prefill/decode disaggregated splits")
+        self.flow_enqueued = reg.counter(
+            "llm_d_epp_flow_enqueued_total", "Requests admitted to flow queues")
+        self.flow_dispatched = reg.counter(
+            "llm_d_epp_flow_dispatched_total",
+            "Requests dispatched from flow queues")
+        self.flow_rejected_capacity = reg.counter(
+            "llm_d_epp_flow_rejected_capacity_total",
+            "Requests rejected for queue capacity")
+        self.flow_evicted_ttl = reg.counter(
+            "llm_d_epp_flow_evicted_ttl_total",
+            "Queued requests evicted on TTL expiry")
+        self.flow_queue_depth = reg.gauge(
+            "llm_d_epp_flow_queue_depth",
+            "Requests currently waiting in flow-control queues")
+        self.flow_queue_wait = reg.histogram(
+            "llm_d_epp_flow_queue_wait_seconds",
+            "Enqueue-to-dispatch wait in the flow-control queue",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0,
+                     2.5, 5.0, 10.0, 30.0))
+        self.igw_queue_depth = reg.gauge(
+            "igw_queue_depth",
+            "External autoscaling signal: queued requests")
+        self.igw_running = reg.gauge(
+            "igw_running_requests",
+            "External autoscaling signal: in-flight requests")
+        self.ttft = reg.summary(
+            "llm_d_epp_ttft_seconds", "Time to first token")
+        self.e2e = reg.histogram(
+            "llm_d_epp_e2e_seconds", "End-to-end request latency",
+            buckets=(0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0))
+
+
+def register_engine_metrics(reg: Registry) -> EngineMetrics:
+    return EngineMetrics(reg)
+
+
+def register_engine_server_metrics(reg: Registry) -> EngineServerMetrics:
+    return EngineServerMetrics(reg)
+
+
+def register_router_metrics(reg: Registry) -> RouterMetrics:
+    return RouterMetrics(reg)

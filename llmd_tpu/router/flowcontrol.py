@@ -187,6 +187,9 @@ class FlowController:
             "enqueued_total": 0, "dispatched_total": 0, "rejected_capacity_total": 0,
             "evicted_ttl_total": 0, "queue_depth": 0,
         }
+        # obs.metrics Histogram observing enqueue→dispatch wait; attached by
+        # the router (llm_d_epp_flow_queue_wait_seconds), None standalone
+        self.queue_wait_histogram = None
         self._shutdown = False
 
     # -- API ---------------------------------------------------------------
@@ -249,6 +252,9 @@ class FlowController:
                 continue
             self.metrics["dispatched_total"] += 1
             self.metrics["queue_depth"] = self._total_queued()
+            if self.queue_wait_histogram is not None:
+                self.queue_wait_histogram.observe(
+                    time.monotonic() - item.enqueue_time)
             if not item.future.done():
                 item.future.set_result(RequestOutcome.DISPATCHED)
             await asyncio.sleep(0)  # yield so dispatched request can start

@@ -184,14 +184,33 @@ class RouterServer:
         )
         self._runner: Optional[web.AppRunner] = None
         self._session: Optional[aiohttp.ClientSession] = None
-        self.metrics = {
-            "requests_total": 0, "responses_total": 0, "errors_total": 0,
-            "ttft_sum": 0.0, "ttft_count": 0,
-        }
-        # e2e latency histogram (promql.md alert HighP99Latency reads the buckets)
-        self._e2e_buckets = (0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
-        self._e2e_counts = [0] * (len(self._e2e_buckets) + 1)
-        self._e2e_sum = 0.0
+        # EPP metric families (llm_d_epp_* / igw_*) live in one shared
+        # registry; /metrics renders via Registry.expose() — the same code
+        # path the engine server uses. Legacy counter dicts (scheduler.metrics,
+        # flow.metrics) surface through scrape-time callbacks, so their owners
+        # keep single-writer semantics.
+        from llmd_tpu.obs.metrics import Registry, register_router_metrics
+
+        self.registry = Registry()
+        self.metrics = register_router_metrics(self.registry)
+        sched = self.scheduler.metrics
+        self.metrics.scheduled.set_function(lambda: sched["scheduled_total"])
+        self.metrics.rejected.set_function(lambda: sched["rejected_total"])
+        self.metrics.pd_splits.set_function(lambda: sched["pd_splits_total"])
+        for fam, key in ((self.metrics.flow_enqueued, "enqueued_total"),
+                         (self.metrics.flow_dispatched, "dispatched_total"),
+                         (self.metrics.flow_rejected_capacity,
+                          "rejected_capacity_total"),
+                         (self.metrics.flow_evicted_ttl, "evicted_ttl_total"),
+                         (self.metrics.flow_queue_depth, "queue_depth")):
+            fam.set_function(
+                lambda k=key: self.flow.metrics[k] if self.flow else 0)
+        self.metrics.igw_queue_depth.set_function(
+            lambda: self.flow.metrics["queue_depth"] if self.flow else 0)
+        self.metrics.igw_running.set_function(
+            lambda: sum(self.ctx.get("inflight_requests", {}).values()))
+        if self.flow is not None:
+            self.flow.queue_wait_histogram = self.metrics.flow_queue_wait
         # OTel-shaped tracing (docs/operations/observability/tracing.md):
         # proxy/EPP span with child hops propagated via traceparent
         from llmd_tpu.obs.tracing import global_tracer
@@ -302,12 +321,8 @@ class RouterServer:
         req.state["model_rewritten_to"] = chosen
 
     def _observe_e2e(self, seconds: float) -> None:
-        self._e2e_sum += seconds
-        for i, b in enumerate(self._e2e_buckets):
-            if seconds <= b:
-                self._e2e_counts[i] += 1
-                return
-        self._e2e_counts[-1] += 1
+        # promql.md alert HighP99Latency reads these buckets
+        self.metrics.e2e.observe(seconds)
 
     def prepare_request(self, path: str, body: dict,
                         headers: dict[str, str]) -> InferenceRequest:
@@ -329,7 +344,7 @@ class RouterServer:
                 span.add_event("flow_control.enqueue")
             outcome = await self.flow.enqueue_and_wait(req)
             if outcome is not RequestOutcome.DISPATCHED:
-                self.metrics["errors_total"] += 1
+                self.metrics.errors.inc()
                 return Rejection(outcome.http_status,
                                  f"flow control: {outcome.value}", deliberate=True)
         return None
@@ -353,7 +368,7 @@ class RouterServer:
             self._sched_executor, self.scheduler.schedule, req
         )
         if result.endpoint is None:
-            self.metrics["errors_total"] += 1
+            self.metrics.errors.inc()
             return None, Rejection(503, f"no endpoint: {result.rejected}")
         return result, None
 
@@ -389,7 +404,7 @@ class RouterServer:
                 timeout=aiohttp.ClientTimeout(total=timeout_s))
             payload = await resp.read()
         except Exception as e:
-            self.metrics["errors_total"] += 1
+            self.metrics.errors.inc()
             return web.json_response(
                 {"error": {"message": f"upstream error: {e}"}}, status=502)
         return web.Response(body=payload, status=resp.status,
@@ -399,7 +414,7 @@ class RouterServer:
     async def _handle_conversation(self, request: web.Request):
         """Forward Conversations API traffic to its sticky pod. Creation gets a
         router-assigned id so the hash mapping exists before any pod is asked."""
-        self.metrics["requests_total"] += 1
+        self.metrics.requests.inc()
         body = None
         if request.method == "POST":
             try:
@@ -420,7 +435,7 @@ class RouterServer:
 
     async def _handle_generate(self, request: web.Request):
         t_start = time.monotonic()
-        self.metrics["requests_total"] += 1
+        self.metrics.requests.inc()
         try:
             body = await request.json()
         except Exception:
@@ -438,7 +453,7 @@ class RouterServer:
                                          status=rej.status)
             target = self._sticky_endpoint(str(body["conversation"]))
             if target is None:
-                self.metrics["errors_total"] += 1
+                self.metrics.errors.inc()
                 return web.json_response({"error": {"message": "no endpoints"}},
                                          status=503)
             from llmd_tpu.obs.tracing import extract_traceparent
@@ -486,7 +501,7 @@ class RouterServer:
                 timeout=aiohttp.ClientTimeout(total=600),
             )
         except Exception as e:
-            self.metrics["errors_total"] += 1
+            self.metrics.errors.inc()
             self.scheduler.post_response(req, target, {"error": str(e)})
             span.set_error(f"upstream error: {e}")
             span.end()
@@ -515,8 +530,7 @@ class RouterServer:
                     t_last = time.monotonic()
                     if t_first is None:
                         t_first = t_last
-                        self.metrics["ttft_sum"] += t_first - t_start
-                        self.metrics["ttft_count"] += 1
+                        self.metrics.ttft.observe(t_first - t_start)
                     n_chunks += 1
                     await out.write(chunk)
                 await out.write_eof()
@@ -527,7 +541,7 @@ class RouterServer:
                     if n_chunks > 1:  # mean inter-chunk latency ≈ ITL/TPOT sample
                         info["itl_ms"] = (t_last - t_first) * 1e3 / (n_chunks - 1)
                 self.scheduler.post_response(req, target, info)
-                self.metrics["responses_total"] += 1
+                self.metrics.responses.inc()
                 if "e2e_ms" in info:
                     self._observe_e2e(info["e2e_ms"] / 1e3)
                 for k in ("ttft_ms", "e2e_ms", "itl_ms"):
@@ -537,8 +551,7 @@ class RouterServer:
                 return out
             payload = await resp.read()
             e2e_s = time.monotonic() - t_start
-            self.metrics["ttft_sum"] += e2e_s
-            self.metrics["ttft_count"] += 1
+            self.metrics.ttft.observe(e2e_s)
             info = {"status": resp.status, "e2e_ms": e2e_s * 1e3}
             try:
                 usage = json.loads(payload).get("usage", {})
@@ -548,7 +561,7 @@ class RouterServer:
             except Exception:
                 pass
             self.scheduler.post_response(req, target, info)
-            self.metrics["responses_total"] += 1
+            self.metrics.responses.inc()
             self._observe_e2e(e2e_s)
             span.set_attribute("llm_d.e2e_ms", round(info["e2e_ms"], 3))
             span.set_attribute("http.status_code", resp.status)
@@ -562,41 +575,10 @@ class RouterServer:
             span.end()  # idempotent backstop for exception exits
 
     async def _metrics(self, request: web.Request):
-        m = self.metrics
-        s = self.scheduler.metrics
-        lines = [
-            f"llm_d_epp_requests_total {m['requests_total']}",
-            f"llm_d_epp_responses_total {m['responses_total']}",
-            f"llm_d_epp_errors_total {m['errors_total']}",
-            f"llm_d_epp_scheduled_total {s['scheduled_total']}",
-            f"llm_d_epp_rejected_total {s['rejected_total']}",
-            f"llm_d_epp_pd_splits_total {s['pd_splits_total']}",
-            f"igw_queue_depth {self.flow.metrics['queue_depth'] if self.flow else 0}",
-            f"igw_running_requests {sum(self.ctx.get('inflight_requests', {}).values())}",
-        ]
-        if self.flow:
-            f = self.flow.metrics
-            lines += [
-                f"llm_d_epp_flow_enqueued_total {f['enqueued_total']}",
-                f"llm_d_epp_flow_dispatched_total {f['dispatched_total']}",
-                f"llm_d_epp_flow_rejected_capacity_total {f['rejected_capacity_total']}",
-                f"llm_d_epp_flow_evicted_ttl_total {f['evicted_ttl_total']}",
-            ]
-        lines += [
-            f"llm_d_epp_ttft_seconds_sum {m['ttft_sum']:.6f}",
-            f"llm_d_epp_ttft_seconds_count {m['ttft_count']}",
-        ]
-        if m["ttft_count"]:
-            lines.append(f"llm_d_epp_ttft_seconds_mean {m['ttft_sum'] / m['ttft_count']:.6f}")
-        cum = 0
-        for b, c in zip(self._e2e_buckets, self._e2e_counts):
-            cum += c
-            lines.append(f'llm_d_epp_e2e_seconds_bucket{{le="{b}"}} {cum}')
-        lines += [
-            f'llm_d_epp_e2e_seconds_bucket{{le="+Inf"}} {cum + self._e2e_counts[-1]}',
-            f"llm_d_epp_e2e_seconds_sum {self._e2e_sum:.6f}",
-            f"llm_d_epp_e2e_seconds_count {cum + self._e2e_counts[-1]}",
-        ]
+        # Registry families (llm_d_epp_*, igw_*) render via the shared
+        # formatter; plugin providers (latency predictor, ext-proc, HA) still
+        # append their own pre-rendered lines after it.
+        lines = [self.registry.expose().rstrip("\n")]
         for plugin in self.scheduler.plugins.values():
             if hasattr(plugin, "prometheus_lines"):
                 lines += plugin.prometheus_lines()

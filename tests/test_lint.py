@@ -45,12 +45,16 @@ def test_observability_kit_validates():
     dashboards = sorted(dash_dir.glob("*.json"))
     assert len(dashboards) >= 6  # parity with the reference's kit size
 
-    # metric names actually exported by the stack
-    exported = set()
-    for src in (ROOT / "llmd_tpu").rglob("*.py"):
-        exported |= set(re.findall(
-            r"(llmd_tpu:[a-z_]+|llm_d_epp_[a-z_]+|igw_[a-z_]+|vllm:[a-z_]+)",
-            src.read_text(errors="replace")))
+    # metric names actually exported by the stack: registry families (with
+    # their _bucket/_sum/_count series) plus raw-line provider scans — the
+    # same union tools/lint_metrics.py checks in CI
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import lint_metrics
+
+        exported = lint_metrics.registry_families() | lint_metrics.rawline_families()
+    finally:
+        sys.path.remove(str(ROOT / "tools"))
 
     metric_pat = re.compile(r"(llmd_tpu:[a-z_]+|llm_d_epp_[a-z_]+|igw_[a-z_]+|vllm:[a-z_]+)")
     for dash in dashboards:
@@ -85,5 +89,5 @@ def test_ci_gate_composes_stages():
     summary = json.loads(proc.stdout.strip().splitlines()[-1])
     assert summary["gate"] == "ok"
     assert [s["stage"] for s in summary["stages"]] == [
-        "lint-envvars", "validate-manifests"]
+        "lint-envvars", "lint-metrics", "validate-manifests"]
     assert all(s["ok"] for s in summary["stages"])

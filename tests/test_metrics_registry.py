@@ -1,0 +1,328 @@
+"""Unified metrics registry: exposition-format round trips, label escaping,
+and engine-loop instrumentation (ISSUE 1 tentpole).
+
+Covers:
+- registry unit behavior (cumulative buckets, sum/count, escaping, callbacks);
+- the LoRA adapter-name escaping regression (engine/server.py:795 hazard);
+- both servers' /metrics parsed by the minimal Prometheus parser with
+  `_bucket` monotonicity and `_sum`/`_count` consistency asserted;
+- presence of every StdMetric contract key for engine type `llmd-tpu`;
+- the new engine-step histogram families carrying samples after a smoke
+  generation, and offload hit/miss/transfer instrumentation.
+"""
+
+import asyncio
+import re
+
+import aiohttp
+import numpy as np
+import pytest
+
+from llmd_tpu.core.metrics_contract import (
+    StdMetric,
+    map_engine_metrics,
+    parse_prometheus,
+)
+from llmd_tpu.obs.metrics import (
+    Registry,
+    escape_label_value,
+    register_engine_metrics,
+)
+from tests.conftest import run_async
+
+# ------------------------------------------------------------------ registry
+
+
+def test_counter_gauge_basics():
+    reg = Registry()
+    c = reg.counter("t:c_total", "help text")
+    g = reg.gauge("t:g", "a gauge")
+    c.inc()
+    c.inc(4)
+    g.set(2.5)
+    g.inc()
+    text = reg.expose()
+    assert "# TYPE t:c_total counter" in text
+    assert "# HELP t:c_total help text" in text
+    assert "t:c_total 5" in text
+    assert "t:g 3.5" in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_unlabeled_families_expose_zero_before_first_increment():
+    reg = Registry()
+    reg.counter("t:untouched_total")
+    reg.histogram("t:h_seconds", buckets=(1.0,))
+    samples = dict(((n, l), v) for n, l, v in reg.collect())
+    assert samples[("t:untouched_total", "")] == 0
+    assert samples[("t:h_seconds_count", "")] == 0
+
+
+def test_registration_is_idempotent_but_type_checked():
+    reg = Registry()
+    a = reg.counter("t:x_total")
+    assert reg.counter("t:x_total") is a
+    with pytest.raises(ValueError):
+        reg.gauge("t:x_total")
+
+
+def test_histogram_cumulative_buckets_and_consistency():
+    reg = Registry()
+    h = reg.histogram("t:lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    samples = parse_prometheus(reg.expose())
+    buckets = [(lab["le"], val) for name, lab, val in samples
+               if name == "t:lat_seconds_bucket"]
+    assert buckets == [("0.1", 1.0), ("1", 3.0), ("10", 4.0), ("+Inf", 5.0)]
+    s = {name: val for name, lab, val in samples if not lab}
+    assert s["t:lat_seconds_count"] == 5
+    assert abs(s["t:lat_seconds_sum"] - 56.05) < 1e-9
+
+
+def test_labeled_children_and_callback_values():
+    reg = Registry()
+    h = reg.histogram("t:d_seconds", labelnames=("phase",), buckets=(1.0,))
+    h.labels(phase="a").observe(0.5)
+    h.labels(phase="b").observe(2.0)
+    state = {"n": 7}
+    c = reg.counter("t:cb_total")
+    c.set_function(lambda: state["n"])
+    samples = parse_prometheus(reg.expose())
+    by = {(n, l.get("phase"), l.get("le")): v for n, l, v in samples}
+    assert by[("t:d_seconds_bucket", "a", "1")] == 1.0
+    assert by[("t:d_seconds_bucket", "b", "1")] == 0.0
+    assert by[("t:d_seconds_bucket", "b", "+Inf")] == 1.0
+    assert by[("t:cb_total", None, None)] == 7.0
+    with pytest.raises(ValueError):
+        h.labels(wrong="x")
+
+
+def test_label_value_escaping():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    reg = Registry()
+    g = reg.gauge("t:info", labelnames=("name",))
+    g.labels(name='ev"il\\ad\napter').set(1)
+    text = reg.expose()
+    # exposition must stay one-sample-per-line and parseable
+    sample_lines = [ln for ln in text.splitlines() if not ln.startswith("#")]
+    assert len(sample_lines) == 1
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    (name, labels, value), = parse_prometheus(text)
+    assert name == "t:info" and value == 1.0
+
+
+def _assert_exposition_well_formed(text: str) -> None:
+    """Shared round-trip checks: parseable, buckets monotone & +Inf-closed,
+    _count == +Inf bucket, _sum present for every histogram child."""
+    samples = parse_prometheus(text)
+    assert samples
+    hists: dict[tuple, list[tuple[float, float]]] = {}
+    scalars = {}
+    for name, labels, value in samples:
+        if name.endswith("_bucket"):
+            key = (name[:-7],
+                   tuple(sorted((k, v) for k, v in labels.items() if k != "le")))
+            hists.setdefault(key, []).append(
+                (float("inf") if labels["le"] == "+Inf" else float(labels["le"]),
+                 value))
+        else:
+            scalars[(name, tuple(sorted(labels.items())))] = value
+    assert hists, "no histogram families in exposition"
+    for (base, labels), series in hists.items():
+        series.sort()
+        bounds = [b for b, _ in series]
+        counts = [c for _, c in series]
+        assert bounds[-1] == float("inf"), f"{base}: no +Inf bucket"
+        assert counts == sorted(counts), f"{base}{labels}: non-monotone buckets"
+        assert scalars[(base + "_count", labels)] == counts[-1]
+        assert (base + "_sum", labels) in scalars
+        if counts[-1] == 0:
+            assert scalars[(base + "_sum", labels)] == 0
+
+
+# --------------------------------------------------------------- engine side
+
+
+async def _engine_server_scenario():
+    from llmd_tpu.engine.config import EngineConfig
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.models import get_model_config
+    from llmd_tpu.models.lora import LoRAConfig
+
+    server = EngineServer(
+        get_model_config("tiny"),
+        EngineConfig(page_size=8, num_pages=32, max_model_len=64,
+                     max_batch_size=2, prefill_chunk=16,
+                     lora=LoRAConfig(max_adapters=2, rank=4)),
+        model_name="llmd-tpu/tiny", port=0)
+    # regression (server.py label-escaping hazard): an adapter whose name
+    # carries quote/backslash/newline must not corrupt the exposition. The
+    # HTTP load path rejects such names; a programmatic loader can still
+    # install one, and /metrics has to survive it.
+    hostile = 'ev"il\\ad\napter'
+    server.engine.load_lora_adapter(hostile)
+    # surface it in the waiting list so the info gauge renders the name
+    server.engine.lora_registry.on_waiting(hostile)
+    await server.start()
+    try:
+        base = f"http://{server.address}"
+        async with aiohttp.ClientSession() as sess:
+            r = await sess.post(f"{base}/v1/completions", json={
+                "prompt": "smoke generation for metrics", "max_tokens": 4,
+                "temperature": 0.0, "ignore_eos": True,
+            })
+            assert r.status == 200, await r.text()
+            r = await sess.get(f"{base}/metrics")
+            text = await r.text()
+    finally:
+        await server.stop()
+    return text
+
+
+def test_engine_metrics_round_trip_contract_and_step_families():
+    text = run_async(_engine_server_scenario())
+    _assert_exposition_well_formed(text)
+    samples = parse_prometheus(text)
+
+    # every StdMetric contract key resolves for engine type llmd-tpu
+    out = map_engine_metrics("llmd-tpu", samples)
+    for key in (StdMetric.QUEUED_REQUESTS, StdMetric.RUNNING_REQUESTS,
+                StdMetric.KV_UTILIZATION, StdMetric.BLOCK_SIZE,
+                StdMetric.NUM_BLOCKS):
+        assert key in out, f"missing contract key {key}"
+    assert out[StdMetric.BLOCK_SIZE] == 8
+    assert out[StdMetric.NUM_BLOCKS] == 32
+
+    by_name: dict[str, float] = {}
+    for name, labels, value in samples:
+        by_name[name] = by_name.get(name, 0.0) + value
+    # the smoke generation drove the step loop: step-duration histogram by
+    # phase, batch occupancy, and token throughput all carry samples
+    assert by_name["llmd_tpu:engine_step_duration_seconds_count"] > 0
+    assert by_name["llmd_tpu:engine_batch_occupancy_count"] > 0
+    assert by_name["llmd_tpu:prefill_tokens_total"] > 0
+    assert by_name["llmd_tpu:decode_tokens_total"] > 0
+    phases = {labels["phase"] for name, labels, _ in samples
+              if name == "llmd_tpu:engine_step_duration_seconds_count"}
+    assert "unified" in phases
+    # legacy families survive the rewiring
+    for fam in ("llmd_tpu:requests_total", "llmd_tpu:preemptions_total",
+                "llmd_tpu:kv_block_exhaustion_total",
+                "llmd_tpu:kv_transfer_pull_failures_total"):
+        assert fam in by_name, f"missing family {fam}"
+    assert by_name["llmd_tpu:requests_total"] == 1
+
+    # the hostile adapter name round-trips through the escaper
+    lora = [(labels, v) for name, labels, v in samples
+            if name == "vllm:lora_requests_info"]
+    assert len(lora) == 1
+    labels, value = lora[0]
+    assert value == 1.0
+    unescaped = (labels["waiting_lora_adapters"]
+                 .replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\"))
+    assert 'ev"il' in unescaped and "\napter" in unescaped
+
+
+# --------------------------------------------------------------- router side
+
+
+ROUTER_CFG = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+flowControl:
+  enabled: true
+  bands:
+    - priority: 0
+      name: default
+      maxRequests: 16
+"""
+
+
+async def _router_scenario():
+    from llmd_tpu.core.config import FrameworkConfig
+    from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+    from llmd_tpu.engine.config import EngineConfig
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.models import get_model_config
+    from llmd_tpu.router import filters_pickers as _fp, scorers as _s  # noqa: F401
+    from llmd_tpu.router.plugins import known_plugin_types
+    from llmd_tpu.router.server import RouterServer
+
+    eng_srv = EngineServer(
+        get_model_config("tiny"),
+        EngineConfig(page_size=8, num_pages=32, max_model_len=64,
+                     max_batch_size=2, prefill_chunk=16),
+        model_name="llmd-tpu/tiny", port=0)
+    await eng_srv.start()
+    pool = EndpointPool()
+    pool.upsert(Endpoint(address=eng_srv.address))
+    router = RouterServer(
+        FrameworkConfig.from_yaml(ROUTER_CFG, known_types=known_plugin_types()),
+        pool, port=0, poll_interval_s=0.2)
+    await router.start()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            r = await sess.post(f"http://{router.address}/v1/completions", json={
+                "model": "llmd-tpu/tiny", "prompt": "router metrics smoke",
+                "max_tokens": 3, "temperature": 0.0,
+            })
+            assert r.status == 200, await r.text()
+            r = await sess.get(f"http://{router.address}/metrics")
+            text = await r.text()
+    finally:
+        await router.stop()
+        await eng_srv.stop()
+    return text
+
+
+def test_router_metrics_round_trip_and_flow_families():
+    text = run_async(_router_scenario())
+    _assert_exposition_well_formed(text)
+    by_name: dict[str, float] = {}
+    for name, labels, value in parse_prometheus(text):
+        by_name[name] = by_name.get(name, 0.0) + value
+    assert by_name["llm_d_epp_requests_total"] == 1
+    assert by_name["llm_d_epp_responses_total"] == 1
+    assert by_name["llm_d_epp_ttft_seconds_count"] == 1
+    assert by_name["llm_d_epp_e2e_seconds_count"] == 1
+    # flow-control queue instrumentation: depth gauge + enqueue→dispatch wait
+    assert by_name["llm_d_epp_flow_enqueued_total"] == 1
+    assert by_name["llm_d_epp_flow_dispatched_total"] == 1
+    assert by_name["llm_d_epp_flow_queue_wait_seconds_count"] == 1
+    assert by_name["llm_d_epp_flow_queue_depth"] == 0
+    # autoscaling externals stay exposed
+    assert "igw_queue_depth" in by_name
+    assert "igw_running_requests" in by_name
+
+
+# -------------------------------------------------------------- offload tier
+
+
+def test_offload_store_hit_miss_evict_and_transfer_bytes():
+    from llmd_tpu.kv.offload import CPUOffloadStore
+
+    reg = Registry()
+    em = register_engine_metrics(reg)
+    store = CPUOffloadStore(2, metrics=em)
+    a = np.zeros((4, 8), np.float32)
+    store.put(1, a)
+    store.put(2, a)
+    assert store.get(1) is not None      # hit
+    assert store.get(99) is None         # miss
+    store.put(3, a)                      # evicts LRU (2)
+    assert em.offload_hits.value == 1
+    assert em.offload_misses.value == 1
+    assert em.offload_evictions.value == 1
+    samples = {(n, l.get("direction")): v
+               for n, l, v in parse_prometheus(reg.expose())}
+    assert samples[("llmd_tpu:offload_transfer_bytes_count", "save")] == 3
+    assert samples[("llmd_tpu:offload_transfer_bytes_count", "load")] == 1
+    assert samples[("llmd_tpu:offload_transfer_bytes_sum", "save")] == 3 * a.nbytes

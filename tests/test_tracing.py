@@ -186,3 +186,112 @@ schedulingProfiles:
             await backend.stop()
 
     run_async(scenario())
+
+
+def test_traceparent_malformed_variants():
+    """ISSUE 1 satellite: the extractor must shrug at every mangled header."""
+    from llmd_tpu.obs.tracing import extract_traceparent
+
+    good_trace, good_span = "a" * 32, "b" * 16
+    bad = [
+        f"00-{good_trace}-{good_span}",            # missing flags field
+        f"00-{good_trace}-{good_span}-01-extra",   # too many fields
+        f"00-{good_trace[:-1]}-{good_span}-01",    # short trace id
+        f"00-{good_trace}-{good_span}0-01",        # long span id
+        f"00-{good_trace}-{'0' * 16}-01",          # all-zero span id
+        f"00-{good_trace}-{good_span}-zz",         # non-hex flags
+        f"00-{'g' * 32}-{good_span}-01",           # non-hex trace id
+        "",                                         # empty value
+    ]
+    for value in bad:
+        assert extract_traceparent({"traceparent": value}) is None, value
+    # surrounding whitespace is tolerated (header values get folded)
+    ctx = extract_traceparent({"traceparent": f"  00-{good_trace}-{good_span}-01  "})
+    assert ctx is not None and ctx.sampled
+
+
+def test_parent_based_sampling_overrides_ratio_both_ways():
+    from llmd_tpu.obs.tracing import SpanContext, Tracer, TracingConfig
+
+    # ratio 1.0 would sample every root, but an UNSAMPLED parent wins
+    t = Tracer(TracingConfig(enabled=True, sample_ratio=1.0, exporter="memory"))
+    off = SpanContext(trace_id="1" * 32, span_id="2" * 16, sampled=False)
+    child = t.start_span("child", parent=off)
+    assert not child.context.sampled
+    child.end()
+    assert t.spans == []  # unsampled spans are never exported
+
+
+def test_jsonl_exporter_round_trip(tmp_path):
+    """Exported lines rebuild into the same OTLP span shapes."""
+    import json as _json
+
+    from llmd_tpu.obs.tracing import Tracer, TracingConfig
+
+    path = str(tmp_path / "rt.jsonl")
+    t = Tracer(TracingConfig(enabled=True, sample_ratio=1.0, exporter="jsonl",
+                             jsonl_path=path))
+    with t.start_span("parent", **{"llm_d.model": "tiny"}) as parent:
+        parent.add_event("milestone", n=3)
+        child = t.start_span("child", parent=parent.context)
+        child.end()
+    t.close()
+    lines = [_json.loads(l) for l in open(path)]
+    by_name = {l["name"]: l for l in lines}
+    assert set(by_name) == {"parent", "child"}
+    p, c = by_name["parent"], by_name["child"]
+    assert c["traceId"] == p["traceId"]
+    assert c["parentSpanId"] == p["spanId"]
+    assert int(p["endTimeUnixNano"]) >= int(p["startTimeUnixNano"])
+    assert p["events"][0]["name"] == "milestone"
+    attrs = {a["key"]: a["value"]["stringValue"] for a in p["attributes"]}
+    assert attrs["llm_d.model"] == "tiny"
+
+
+def test_engine_step_spans_nest_under_request_span():
+    """ISSUE 1 tentpole: engine steps appear as children of engine.generate."""
+
+    async def scenario():
+        from llmd_tpu.engine.config import EngineConfig
+        from llmd_tpu.engine.server import EngineServer
+        from llmd_tpu.models import get_model_config
+        from llmd_tpu.obs.tracing import (
+            SpanContext,
+            Tracer,
+            TracingConfig,
+            format_traceparent,
+        )
+
+        tracer = Tracer(TracingConfig(enabled=True, sample_ratio=1.0,
+                                      exporter="memory"))
+        srv = EngineServer(
+            get_model_config("tiny"),
+            EngineConfig(page_size=8, num_pages=32, max_model_len=64,
+                         max_batch_size=2, prefill_chunk=16),
+            model_name="llmd-tpu/tiny", port=0)
+        srv.tracer = tracer
+        srv.engine.tracer = tracer  # step spans share the request trace
+        await srv.start()
+        try:
+            client = SpanContext(trace_id="7" * 32, span_id="8" * 16, sampled=True)
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://{srv.address}/v1/completions",
+                    json={"prompt": "trace the step loop", "max_tokens": 3,
+                          "temperature": 0.0, "ignore_eos": True},
+                    headers={"traceparent": format_traceparent(client)},
+                ) as resp:
+                    assert resp.status == 200
+        finally:
+            await srv.stop()
+
+        gen = [sp for sp in tracer.spans if sp.name == "engine.generate"]
+        steps = [sp for sp in tracer.spans if sp.name == "engine.step"]
+        assert len(gen) == 1 and steps
+        assert all(sp.parent_span_id == gen[0].context.span_id for sp in steps)
+        assert all(sp.context.trace_id == "7" * 32 for sp in steps)
+        phases = {sp.attributes["llm_d.phase"] for sp in steps}
+        assert "unified" in phases  # the prompt prefilled through the mixed step
+        assert all(sp.end_ns >= sp.start_ns for sp in steps)
+
+    run_async(scenario())

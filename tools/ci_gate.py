@@ -53,6 +53,7 @@ def main() -> int:
     py = sys.executable
     stages = [
         ("lint-envvars", [py, "tools/lint_envvars.py"], None),
+        ("lint-metrics", [py, "tools/lint_metrics.py"], CPU_ENV),
         ("validate-manifests", [py, "tools/validate_manifests.py", "deploy"], None),
     ]
     if not args.skip_tests:
