@@ -18,6 +18,7 @@ test:
 
 lint:
 	$(PY) tools/lint_envvars.py
+	$(PY) tools/lint_events.py
 
 manifests:
 	$(PY) tools/validate_manifests.py deploy

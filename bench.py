@@ -188,11 +188,15 @@ def main() -> None:
                     # *_preclamp.json are lever-attribution records of STALE
                     # code states; the strict pattern keeps them out.
                     camps = sorted(
-                        p for p in _glob.glob(os.path.join(
+                        (p for p in _glob.glob(os.path.join(
                             os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_CAMPAIGN_r*.json"))
-                        if _re.fullmatch(r"BENCH_CAMPAIGN_r\d+\.json",
-                                         os.path.basename(p)))
+                         if _re.fullmatch(r"BENCH_CAMPAIGN_r\d+\.json",
+                                          os.path.basename(p))),
+                        # numeric round order: lexicographic sort would rank
+                        # r9 above r10 and resurface a stale round's number
+                        key=lambda p: int(_re.search(
+                            r"r(\d+)", os.path.basename(p)).group(1)))
                     camp = camps[-1] if camps else ""
                     with open(camp) as f:
                         data = json.load(f)

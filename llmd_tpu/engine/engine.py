@@ -38,6 +38,7 @@ from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.kv_manager import PageAllocator, Sequence
 from llmd_tpu.engine.sampling import sample_tokens
 from llmd_tpu.models.config import ModelConfig
+from llmd_tpu.obs.events import FlightRecorder
 from llmd_tpu.obs.metrics import Registry, register_engine_metrics
 from llmd_tpu.obs.tracing import global_tracer
 from llmd_tpu.models.transformer import (
@@ -144,6 +145,9 @@ class LLMEngine:
             block_size=engine_cfg.page_size,
             num_gpu_blocks=engine_cfg.num_pages).set(1)
         self.tracer = global_tracer()
+        # always-on per-request lifecycle timelines; EngineServer exposes
+        # this recorder at /debug/requests (obs.events)
+        self.flight = FlightRecorder.from_env(tracer=self.tracer)
         self.offload = None
         if engine_cfg.cpu_offload_pages > 0 or engine_cfg.offload_fs_path:
             from llmd_tpu.kv.fs_backend import FSKVBackend
@@ -155,7 +159,7 @@ class LLMEngine:
                 staging_blocks=engine_cfg.offload_staging_blocks,
                 fs_backend=fs, event_sink=event_sink,
                 pages_per_layer=engine_cfg.num_pages,
-                metrics=self.metrics,
+                metrics=self.metrics, flight=self.flight,
             )
             self.alloc.evict_hook = lambda h, pid: self.offload.on_evict(self.cache, h, pid)
             store = self.offload.store
@@ -444,8 +448,10 @@ class LLMEngine:
                 raise ValueError(
                     "attn_impl='pallas' cannot serve MLA models (latent "
                     "head_dim exceeds the kernel's head sizes); use 'auto'")
+            # xla_mla_absorbed is the DESIGNED backend for MLA, not a
+            # degradation — provenance lives in attn_backend alone so
+            # fallback alerts stay quiet on healthy MLA engines
             self.attn_backend = "xla_mla_absorbed"
-            self.attn_fallback_reason = "mla: latent head_dim beyond Pallas kernel"
             return ragged_paged_attention_xla
         if mode == "reference":
             self.attn_backend = "xla_reference"
@@ -782,6 +788,10 @@ class LLMEngine:
         }
         self.seqs[request_id] = seq
         self.waitq[rank].append(seq)
+        self.flight.start(request_id, model=self.model_cfg.name,
+                          trace_id=getattr(trace_ctx, "trace_id", "") or "")
+        self.flight.record(request_id, "arrival", prompt_len=len(token_ids),
+                           rank=rank, lora=lora_id)
         if self.lora_registry is not None:
             self.lora_registry.on_waiting(lora_id)
 
@@ -789,6 +799,8 @@ class LLMEngine:
         seq = self.seqs.pop(request_id, None)
         if seq is None:
             return
+        self.flight.finish(request_id, event="aborted", status="aborted",
+                           generated=seq.num_generated)
         if seq.slot >= 0:
             self.running[seq.slot] = None
             if self.lora_registry is not None:
@@ -887,6 +899,8 @@ class LLMEngine:
                 seq.finished = True
                 seq.finish_reason = "length"
                 self.seqs.pop(seq.request_id, None)
+                self.flight.finish(seq.request_id, event="retired",
+                                   reason="length", generated=seq.num_generated)
                 self._outputs.append(EngineOutput(
                     request_id=seq.request_id, new_token_ids=[], finished=True,
                     finish_reason="length", prompt_len=seq.prompt_len,
@@ -912,6 +926,9 @@ class LLMEngine:
             seq.slot = slot
             self.running[slot] = seq
             waiting.popleft()
+            self.flight.record(seq.request_id, "admitted", slot=slot,
+                               rank=rank, cached_tokens=seq.num_cached_prompt,
+                               pages=len(seq.pages))
             if self.lora_registry is not None:
                 self.lora_registry.on_running(seq.lora_id)
 
@@ -931,7 +948,8 @@ class LLMEngine:
         if not off_pids:
             return []
         self.cache, n_loaded = self.offload.load_into_cache(
-            self.cache, keys[n_hbm : n_hbm + len(off_pids)], off_pids
+            self.cache, keys[n_hbm : n_hbm + len(off_pids)], off_pids,
+            request_id=seq.request_id,
         )
         for pid in off_pids[n_loaded:]:  # block vanished mid-way (FS evictor race)
             self.alloc.release(pid)
@@ -1031,6 +1049,8 @@ class LLMEngine:
         self.waitq[rank].appendleft(victim)
         self.stats.total_preemptions += 1
         self.metrics.preemptions.inc()
+        self.flight.record(victim.request_id, "preempted", rank=rank,
+                           generated=victim.num_generated)
         return True
 
     # --------------------------------------------------------------- stepping
@@ -1083,6 +1103,15 @@ class LLMEngine:
                    "llm_d.request_id": s.request_id})
             span.start_ns = start_ns
             span.end()
+
+    def _trace_exemplar(self, seqs) -> Optional[dict]:
+        """OpenMetrics exemplar labels from the first traced seq in a batch —
+        feeds the step-duration histogram so a slow bucket links to a trace."""
+        for s in seqs:
+            ctx = s.trace_ctx
+            if ctx is not None and getattr(ctx, "trace_id", ""):
+                return {"trace_id": ctx.trace_id}
+        return None
 
     def _offload_drain(self) -> None:
         """Keep the plain free list above the watermark by batch-demoting the oldest
@@ -1254,9 +1283,17 @@ class LLMEngine:
                 sample_list.append((i, s))
                 has_decode_rows = True
             else:
+                if s.num_computed == s.num_cached_prompt:
+                    # first chunk of a (re)prefill — cached==computed only holds
+                    # before any chunk lands (and again after preemption resets)
+                    self.flight.record(s.request_id, "prefill_start",
+                                       cached_tokens=s.num_cached_prompt)
                 s.num_computed += n
                 s.maybe_commit_blocks(self.allocs[s.rank])
                 self.stats.total_prefill_tokens += n
+                if s.num_computed >= self._prefill_target(s):
+                    self.flight.record(s.request_id, "prefill_end",
+                                       prefill_tokens=s.num_computed)
                 if (len(s.token_ids) == s.prompt_len
                         and s.num_computed == s.prompt_len):
                     # fresh prefill complete: sample first token from last logits
@@ -1290,7 +1327,8 @@ class LLMEngine:
             self.metrics.decode_tokens.inc(n_dec)
         if n_pre:
             self.metrics.prefill_tokens.inc(n_pre)
-        self.metrics.step_duration.labels(phase="unified").observe(t3 - t0)
+        self.metrics.step_duration.labels(phase="unified").observe(
+            t3 - t0, exemplar=self._trace_exemplar([s for s, _, _ in plan]))
         self._emit_step_spans("unified", [s for s, _, _ in plan], t0_ns,
                               len(plan), n_pre + n_dec)
 
@@ -1420,7 +1458,8 @@ class LLMEngine:
         self.stats.time_decode_steps += time.perf_counter() - wall_start
         self.stats.n_decode_dispatches += 1
         self.metrics.step_duration.labels(phase="decode_dispatch").observe(
-            time.perf_counter() - wall_start)
+            time.perf_counter() - wall_start,
+            exemplar=self._trace_exemplar(active))
         # Start the device->host copy of everything _decode_process will read.
         # Remote/tunneled runtimes defer execution until a result is demanded;
         # the async-copy hint makes the call run (and its tokens land on the
@@ -1462,10 +1501,16 @@ class LLMEngine:
             s.num_computed = len(s.token_ids) - 1
             if s.first_token_time is None:
                 s.first_token_time = now
+                self.flight.record(
+                    s.request_id, "first_token",
+                    ttft_ms=round((now - s.arrival_time) * 1e3, 3))
             s.maybe_commit_blocks(self.allocs[s.rank])
             self.stats.total_decode_tokens += len(kept)
             self.stats.decode_tokens_fused += len(kept)
             n_tokens += len(kept)
+            # one progress event per fused k-step call (per-N decode progress)
+            self.flight.record(s.request_id, "decode", n_tokens=len(kept),
+                               generated=s.num_generated)
             if finished:
                 self._retire(s, reason)
             self._outputs.append(EngineOutput(
@@ -1482,7 +1527,8 @@ class LLMEngine:
         st.n_decode_calls += 1
         if n_tokens:
             self.metrics.decode_tokens.inc(n_tokens)
-        self.metrics.step_duration.labels(phase="decode_process").observe(t3 - t1)
+        self.metrics.step_duration.labels(phase="decode_process").observe(
+            t3 - t1, exemplar=self._trace_exemplar([s for s, _ in rec["rows"]]))
         self._emit_step_spans("decode", [s for s, _ in rec["rows"]], t1_ns,
                               len(rec["rows"]), n_tokens)
 
@@ -1490,6 +1536,11 @@ class LLMEngine:
         """Shared retirement path: free slot + pages, drop from the live map."""
         seq.finished = True
         seq.finish_reason = reason
+        self.flight.finish(
+            seq.request_id, event="retired", reason=reason or "",
+            generated=seq.num_generated,
+            ttft_ms=round((seq.first_token_time - seq.arrival_time) * 1e3, 3)
+            if seq.first_token_time is not None else None)
         if self.kv_connector is not None and seq.block_hashes:
             # K5 save path: dispatch the chunked staging here (cheap, same
             # helper as the P/D export path), drain + hand bytes to the
@@ -1578,6 +1629,9 @@ class LLMEngine:
             s.token_ids.append(tok)
             if s.first_token_time is None:
                 s.first_token_time = now
+                self.flight.record(
+                    s.request_id, "first_token",
+                    ttft_ms=round((now - s.arrival_time) * 1e3, 3))
             finished, reason = self._check_finish(s, tok)
             if finished:
                 self._retire(s, reason)

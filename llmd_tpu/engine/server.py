@@ -222,6 +222,8 @@ class EngineServer:
         app.router.add_delete("/v1/conversations/{cid}", self._conv_delete)
         app.router.add_post("/v1/conversations/{cid}/items", self._conv_add_items)
         app.router.add_get("/v1/conversations/{cid}/items", self._conv_list_items)
+        app.router.add_get("/debug/requests", self._debug_requests)
+        app.router.add_get("/debug/requests/{rid}", self._debug_request)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -769,6 +771,20 @@ class EngineServer:
 
     async def _health(self, request: web.Request):
         return web.json_response({"status": "ok"})
+
+    async def _debug_requests(self, request: web.Request):
+        from llmd_tpu.obs.events import debug_list_response
+
+        status, payload = debug_list_response(
+            self.engine.flight, request.rel_url.query)
+        return web.json_response(payload, status=status)
+
+    async def _debug_request(self, request: web.Request):
+        from llmd_tpu.obs.events import debug_detail_response
+
+        status, payload = debug_detail_response(
+            self.engine.flight, request.match_info["rid"])
+        return web.json_response(payload, status=status)
 
     async def _models(self, request: web.Request):
         data = [{"id": self.model_name, "object": "model"}]

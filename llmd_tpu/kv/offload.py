@@ -151,9 +151,11 @@ class KVOffloadConnector:
         event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
         pages_per_layer: Optional[int] = None,
         metrics=None,
+        flight=None,
     ) -> None:
         self.store = CPUOffloadStore(num_cpu_chunks, fs_backend, event_sink,
                                      metrics=metrics)
+        self.flight = flight  # obs.events.FlightRecorder or None
         self.staging_blocks = max(1, staging_blocks)
         # cache is the flat layer-folded pool [L*P, ps, 2Hk, Dhp]; P is needed to
         # gather one logical page's rows across layers. None = single-layer pool.
@@ -172,6 +174,8 @@ class KVOffloadConnector:
         about-to-be-recycled page HBM→host (one per-page device sync — the batched
         ``demote_batch`` path is the steady-state eviction route)."""
         self.store.put(block_hash, np.asarray(cache[self._layer_rows(cache, page_id)]))
+        if self.flight is not None:
+            self.flight.record_system("kv_offload", n_blocks=1, path="evict")
 
     def demote_batch(self, cache, pairs: list[tuple[int, int]]) -> None:
         """Offload a batch of demoted pages in ONE device-to-host gather.
@@ -190,6 +194,9 @@ class KVOffloadConnector:
         arr = np.moveaxis(arr, 1, 0)
         for (h, _), block in zip(pairs, arr):
             self.store.put(h, np.ascontiguousarray(block))
+        if self.flight is not None:
+            self.flight.record_system("kv_offload", n_blocks=len(pairs),
+                                      path="drain")
 
     # ------------------------------------------------------------------ match
     def match_suffix(self, block_hashes: list[int]) -> int:
@@ -202,11 +209,14 @@ class KVOffloadConnector:
         return n
 
     # ------------------------------------------------------------------ reload
-    def load_into_cache(self, cache, block_hashes: list[int], page_ids: list[int]):
+    def load_into_cache(self, cache, block_hashes: list[int], page_ids: list[int],
+                        request_id: Optional[str] = None):
         """Scatter offloaded blocks back into freshly allocated pages.
 
         Returns (new_cache, n_loaded) — n_loaded may stop short if a block vanished
         (FS evictor raced us); callers recompute the remainder.
+        ``request_id`` attributes the reload to the admitting request's
+        flight-recorder timeline.
         """
         import jax
         import jax.numpy as jnp
@@ -256,4 +266,7 @@ class KVOffloadConnector:
             for i, a in enumerate(group):
                 stacked[i] = a
             cache = self._load_fn(cache, stacked, pids)
+        if self.flight is not None and request_id and n_loaded:
+            self.flight.record(request_id, "kv_reload", n_blocks=n_loaded,
+                               bytes=sum(a.nbytes for a in arrays))
         return cache, n_loaded

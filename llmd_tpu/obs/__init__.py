@@ -40,8 +40,24 @@ Semantics:
 (``llmd_tpu:*``, ``vllm:*``-compat, ``llm_d_epp_*``, ``igw_*``);
 ``tools/lint_metrics.py`` cross-checks the Grafana dashboards, alert rules,
 and PromQL cookbook against these declarations in CI.
+
+Flight recorder (``llmd_tpu.obs.events``)
+-----------------------------------------
+
+``FlightRecorder`` keeps an always-on, bounded ring of per-request event
+timelines (arrival → routing → flow control → admission → prefill/decode →
+retire) queryable via ``/debug/requests`` on both servers, with SLO tail
+capture force-retaining (and force-tracing) slow requests. Histograms accept
+``observe(v, exemplar={"trace_id": ...})`` and render OpenMetrics exemplar
+annotations so dashboards can jump from a latency bucket to the trace.
+See observability/flight-recorder.md.
 """
 
+from llmd_tpu.obs.events import (
+    EVENT_CATALOG,
+    FlightRecorder,
+    RequestRecord,
+)
 from llmd_tpu.obs.metrics import (
     Counter,
     Gauge,
@@ -63,9 +79,12 @@ from llmd_tpu.obs.tracing import (
 
 __all__ = [
     "Counter",
+    "EVENT_CATALOG",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "Registry",
+    "RequestRecord",
     "Span",
     "Summary",
     "Tracer",

@@ -27,6 +27,7 @@ Design notes:
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -135,13 +136,16 @@ class _Family:
         raise NotImplementedError
 
     # -- exposition -------------------------------------------------------
-    def samples(self) -> Iterable[Tuple[str, str, float]]:
-        """Yield (suffix, rendered-labels, value) triples."""
+    def samples(self) -> Iterable[Tuple[str, str, float, Optional[tuple]]]:
+        """Yield (suffix, rendered-labels, value, exemplar) quads. The
+        exemplar slot is None except on histogram bucket series that
+        captured one (an (labels-dict, value, unix-ts) triple)."""
         if self._fn is not None:
-            yield "", "", float(self._fn())
+            yield "", "", float(self._fn()), None
             return
         for key, child in self._children.items():
-            yield from self._child_samples(key, child)
+            for s in self._child_samples(key, child):
+                yield s if len(s) == 4 else (s[0], s[1], s[2], None)
 
     def _child_samples(self, key, child):  # pragma: no cover - overridden
         raise NotImplementedError
@@ -234,7 +238,12 @@ class _GaugeChild:
 
 class Histogram(_Family):
     """Cumulative-bucket histogram: `_bucket{le=...}` series are cumulative
-    counts, closed by `le="+Inf"`, plus `_sum` and `_count`."""
+    counts, closed by `le="+Inf"`, plus `_sum` and `_count`.
+
+    OpenMetrics exemplars: ``observe(v, exemplar={"trace_id": ...})`` stores
+    the latest exemplar on the bucket ``v`` lands in; exposition appends
+    ``# {trace_id="..."} <value> <ts>`` to that bucket line so Grafana can
+    jump from a latency bucket straight to the trace."""
 
     typ = "histogram"
 
@@ -248,19 +257,20 @@ class Histogram(_Family):
     def _new_child(self):
         return _HistogramChild(self.buckets, self._lock)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
+        self._default().observe(value, exemplar=exemplar)
 
     def _child_samples(self, key, child):
         cum = 0
-        for b, c in zip(self.buckets, child.counts):
+        for i, (b, c) in enumerate(zip(self.buckets, child.counts)):
             cum += c
             yield ("_bucket",
                    _render_labels(self.labelnames, key, (("le", _fmt(b)),)),
-                   cum)
+                   cum, child.exemplars[i])
         yield ("_bucket",
                _render_labels(self.labelnames, key, (("le", "+Inf"),)),
-               child.count)
+               child.count, child.exemplars[len(self.buckets)])
         yield "_sum", _render_labels(self.labelnames, key), child.sum
         yield "_count", _render_labels(self.labelnames, key), child.count
 
@@ -270,18 +280,25 @@ class _HistogramChild:
         self._buckets = buckets
         self._lock = lock
         self.counts = [0] * len(buckets)
+        # latest (labels, value, unix-ts) per bucket, +Inf included
+        self.exemplars: List[Optional[tuple]] = [None] * (len(buckets) + 1)
         self.sum = 0.0
         self.count = 0
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         v = float(value)
         with self._lock:
             self.sum += v
             self.count += 1
+            idx = len(self._buckets)  # +Inf unless a finite bucket catches it
             for i, b in enumerate(self._buckets):
                 if v <= b:
                     self.counts[i] += 1
+                    idx = i
                     break
+            if exemplar:
+                self.exemplars[idx] = (dict(exemplar), v, time.time())
 
 
 class Summary(_Family):
@@ -372,20 +389,29 @@ class Registry:
         out = []
         with self._lock:
             for name, fam in self._families.items():
-                for suffix, labels, value in fam.samples():
+                for suffix, labels, value, _ex in fam.samples():
                     out.append((name + suffix, labels, value))
         return out
 
     def expose(self) -> str:
-        """Render the Prometheus text exposition format."""
+        """Render the Prometheus text exposition format (with OpenMetrics
+        exemplar annotations on histogram buckets that captured one)."""
         lines: List[str] = []
         with self._lock:
             for name, fam in self._families.items():
                 if fam.help:
                     lines.append(f"# HELP {name} {escape_help(fam.help)}")
                 lines.append(f"# TYPE {name} {fam.typ}")
-                for suffix, labels, value in fam.samples():
-                    lines.append(f"{name}{suffix}{labels} {_fmt(value)}")
+                for suffix, labels, value, ex in fam.samples():
+                    line = f"{name}{suffix}{labels} {_fmt(value)}"
+                    if ex is not None:
+                        ex_labels, ex_value, ex_ts = ex
+                        rendered = ",".join(
+                            f'{k}="{escape_label_value(v)}"'
+                            for k, v in ex_labels.items())
+                        line += (f" # {{{rendered}}} {_fmt(ex_value)}"
+                                 f" {ex_ts:.3f}")
+                    lines.append(line)
         return "\n".join(lines) + "\n"
 
 
@@ -532,8 +558,12 @@ class RouterMetrics:
         self.igw_running = reg.gauge(
             "igw_running_requests",
             "External autoscaling signal: in-flight requests")
-        self.ttft = reg.summary(
-            "llm_d_epp_ttft_seconds", "Time to first token")
+        # histogram (was summary) so the buckets can carry trace exemplars —
+        # _sum/_count series are unchanged, rate()-mean queries still work
+        self.ttft = reg.histogram(
+            "llm_d_epp_ttft_seconds", "Time to first token",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0))
         self.e2e = reg.histogram(
             "llm_d_epp_e2e_seconds", "End-to-end request latency",
             buckets=(0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0))

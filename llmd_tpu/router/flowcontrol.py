@@ -190,6 +190,8 @@ class FlowController:
         # obs.metrics Histogram observing enqueue→dispatch wait; attached by
         # the router (llm_d_epp_flow_queue_wait_seconds), None standalone
         self.queue_wait_histogram = None
+        # obs.events FlightRecorder; attached by the router, None standalone
+        self.flight = None
         self._shutdown = False
 
     # -- API ---------------------------------------------------------------
@@ -202,10 +204,17 @@ class FlowController:
         size = req.byte_size or 1024
         if band.over_capacity(size):
             self.metrics["rejected_capacity_total"] += 1
+            if self.flight is not None:
+                self.flight.record(req.request_id, "flow_reject",
+                                   reason="capacity", band=band.spec.name)
             return RequestOutcome.REJECTED_CAPACITY
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         band.push(QueuedItem(req=req, enqueue_time=time.monotonic(), future=fut, byte_size=size))
         self.metrics["enqueued_total"] += 1
+        if self.flight is not None:
+            self.flight.record(req.request_id, "flow_enqueue",
+                               priority=req.priority, band=band.spec.name,
+                               queue_depth=self._total_queued())
         self._wake.set()
         return await fut
 
@@ -238,6 +247,10 @@ class FlowController:
             for band in self.bands.values():
                 for item in band.evict_expired(now):
                     self.metrics["evicted_ttl_total"] += 1
+                    if self.flight is not None:
+                        self.flight.record(
+                            item.req.request_id, "flow_reject", reason="ttl",
+                            waited_ms=round((now - item.enqueue_time) * 1e3, 3))
                     if not item.future.done():
                         item.future.set_result(RequestOutcome.EVICTED_TTL)
             if self.detector.saturated(self.pool):
@@ -255,6 +268,10 @@ class FlowController:
             if self.queue_wait_histogram is not None:
                 self.queue_wait_histogram.observe(
                     time.monotonic() - item.enqueue_time)
+            if self.flight is not None:
+                self.flight.record(
+                    item.req.request_id, "flow_dispatch",
+                    wait_ms=round((time.monotonic() - item.enqueue_time) * 1e3, 3))
             if not item.future.done():
                 item.future.set_result(RequestOutcome.DISPATCHED)
             await asyncio.sleep(0)  # yield so dispatched request can start

@@ -216,6 +216,14 @@ class RouterServer:
         from llmd_tpu.obs.tracing import global_tracer
 
         self.tracer = global_tracer()
+        # always-on per-request flight recorder (obs/events.py): the router
+        # plane records arrival → flow control → routing decision → forward →
+        # response; /debug/requests exposes it live
+        from llmd_tpu.obs.events import FlightRecorder
+
+        self.flight = FlightRecorder.from_env(tracer=self.tracer)
+        if self.flow is not None:
+            self.flow.flight = self.flight
         # extra Prometheus providers (ext-proc EPP front, HA coordinator, ...):
         # callables returning lines, appended to /metrics
         self.extra_metrics: list[Any] = []
@@ -246,6 +254,8 @@ class RouterServer:
         # InferenceModelRewrite weights through here stage by stage
         app.router.add_get("/admin/model-rewrites", self._get_rewrites)
         app.router.add_post("/admin/model-rewrites", self._set_rewrites)
+        app.router.add_get("/debug/requests", self._debug_requests)
+        app.router.add_get("/debug/requests/{rid}", self._debug_request)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -320,9 +330,22 @@ class RouterServer:
         body["model"] = chosen
         req.state["model_rewritten_to"] = chosen
 
-    def _observe_e2e(self, seconds: float) -> None:
-        # promql.md alert HighP99Latency reads these buckets
-        self.metrics.e2e.observe(seconds)
+    @staticmethod
+    def _profile_scores(result) -> Optional[dict]:
+        """Flatten SchedulingResult per-profile endpoint scores for the flight
+        timeline (the "why" behind a routing decision)."""
+        out = {}
+        for name, run in (result.profiles or {}).items():
+            scores = getattr(run, "scores", None)
+            if scores:
+                out[name] = {ep.address: round(s, 4)
+                             for ep, s in scores.items()}
+        return out or None
+
+    def _observe_e2e(self, seconds: float, exemplar=None) -> None:
+        # promql.md alert HighP99Latency reads these buckets; the exemplar
+        # (trace_id of the active span) lets Grafana jump bucket → trace
+        self.metrics.e2e.observe(seconds, exemplar=exemplar)
 
     def prepare_request(self, path: str, body: dict,
                         headers: dict[str, str]) -> InferenceRequest:
@@ -445,48 +468,89 @@ class RouterServer:
         # that conversation's items (and its KV prefix). Admission (flow
         # control, objectives, tracing) still applies — sticky affinity only
         # replaces the scheduler PICK, it is not a shedding bypass.
+        from llmd_tpu.obs.tracing import extract_traceparent
+
         if request.path.endswith("/v1/responses") and body.get("conversation"):
             req = self.prepare_request(request.path, body, headers)
-            rej = await self._flow_gate(req)
+            # span BEFORE the flow gate (parity with the scheduled path) so
+            # the flight record carries a trace id from its first event on
+            span = self.tracer.start_span(
+                "epp.request", parent=extract_traceparent(headers),
+                **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
+                   "http.route": request.path, "llm_d.sticky": True})
+            self.flight.start(req.request_id, model=req.model,
+                              trace_id=span.context.trace_id)
+            self.flight.record(req.request_id, "arrival", path=request.path,
+                               sticky=True)
+            rej = await self._flow_gate(req, span)
             if rej is not None:
+                self.flight.finish(req.request_id, event="rejected",
+                                   status="rejected", reason=rej.message,
+                                   http_status=rej.status)
+                span.set_error(rej.message)
+                span.end()
                 return web.json_response({"error": {"message": rej.message}},
                                          status=rej.status)
             target = self._sticky_endpoint(str(body["conversation"]))
             if target is None:
                 self.metrics.errors.inc()
+                self.flight.finish(req.request_id, event="error",
+                                   status="error", reason="no endpoints",
+                                   http_status=503)
+                span.set_error("no endpoints")
+                span.end()
                 return web.json_response({"error": {"message": "no endpoints"}},
                                          status=503)
-            from llmd_tpu.obs.tracing import extract_traceparent
-
-            span = self.tracer.start_span(
-                "epp.request", parent=extract_traceparent(headers),
-                **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
-                   "http.route": request.path, "llm_d.sticky": True})
             span.set_attribute("llm_d.endpoint", target.address)
+            self.flight.record(req.request_id, "routing_decision",
+                               endpoint=target.address, sticky=True)
+            self.flight.record(req.request_id, "forward",
+                               endpoint=target.address)
             resp = await self._forward_sticky(
                 target, "POST", request.path, body, timeout_s=600,
                 fwd_headers={"content-type": "application/json",
                              "traceparent": span.traceparent(),
                              "x-request-id": req.request_id})
+            if resp.status >= 500:
+                self.flight.finish(req.request_id, event="error",
+                                   status="error", http_status=resp.status)
+            else:
+                self.flight.finish(req.request_id, event="response",
+                                   status="finished", http_status=resp.status)
             span.end()
             return resp
         req = self.prepare_request(request.path, body, headers)
-
-        from llmd_tpu.obs.tracing import extract_traceparent
 
         span = self.tracer.start_span(
             "epp.request", parent=extract_traceparent(headers),
             **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
                "http.route": request.path})
+        self.flight.start(req.request_id, model=req.model,
+                          trace_id=span.context.trace_id)
+        self.flight.record(req.request_id, "arrival", path=request.path)
 
         result, err = await self.admit_and_schedule(req, span=span)
         if err is not None:
+            self.flight.finish(
+                req.request_id,
+                event="rejected" if err.deliberate else "error",
+                status="rejected" if err.deliberate else "error",
+                reason=err.message, http_status=err.status)
             span.set_error(err.message)
             span.end()
             return web.json_response({"error": {"message": err.message}},
                                      status=err.status)
         span.set_attribute("llm_d.endpoint", result.endpoint.address)
         span.add_event("proxy.forward")
+        self.flight.record(
+            req.request_id, "routing_decision",
+            endpoint=result.endpoint.address,
+            prefill_endpoint=(result.prefill_endpoint.address
+                              if result.prefill_endpoint else None),
+            latency_ms=round(result.latency_s * 1e3, 3),
+            scores=self._profile_scores(result))
+        self.flight.record(req.request_id, "forward",
+                           endpoint=result.endpoint.address)
 
         fwd_headers = {"content-type": "application/json",
                        "traceparent": span.traceparent(),
@@ -503,6 +567,8 @@ class RouterServer:
         except Exception as e:
             self.metrics.errors.inc()
             self.scheduler.post_response(req, target, {"error": str(e)})
+            self.flight.finish(req.request_id, event="error", status="error",
+                               reason=f"upstream error: {e}", http_status=502)
             span.set_error(f"upstream error: {e}")
             span.end()
             return web.json_response(
@@ -526,11 +592,13 @@ class RouterServer:
                 t_first = None
                 t_last = t_start
                 n_chunks = 0
+                exemplar = {"trace_id": span.context.trace_id}
                 async for chunk in resp.content.iter_any():
                     t_last = time.monotonic()
                     if t_first is None:
                         t_first = t_last
-                        self.metrics.ttft.observe(t_first - t_start)
+                        self.metrics.ttft.observe(t_first - t_start,
+                                                  exemplar=exemplar)
                     n_chunks += 1
                     await out.write(chunk)
                 await out.write_eof()
@@ -543,7 +611,13 @@ class RouterServer:
                 self.scheduler.post_response(req, target, info)
                 self.metrics.responses.inc()
                 if "e2e_ms" in info:
-                    self._observe_e2e(info["e2e_ms"] / 1e3)
+                    self._observe_e2e(info["e2e_ms"] / 1e3, exemplar=exemplar)
+                self.flight.finish(
+                    req.request_id, event="response", status="finished",
+                    http_status=resp.status,
+                    ttft_ms=(round(info["ttft_ms"], 3)
+                             if "ttft_ms" in info else None),
+                    streamed=True)
                 for k in ("ttft_ms", "e2e_ms", "itl_ms"):
                     if k in info:
                         span.set_attribute(f"llm_d.{k}", round(info[k], 3))
@@ -551,7 +625,8 @@ class RouterServer:
                 return out
             payload = await resp.read()
             e2e_s = time.monotonic() - t_start
-            self.metrics.ttft.observe(e2e_s)
+            exemplar = {"trace_id": span.context.trace_id}
+            self.metrics.ttft.observe(e2e_s, exemplar=exemplar)
             info = {"status": resp.status, "e2e_ms": e2e_s * 1e3}
             try:
                 usage = json.loads(payload).get("usage", {})
@@ -562,7 +637,9 @@ class RouterServer:
                 pass
             self.scheduler.post_response(req, target, info)
             self.metrics.responses.inc()
-            self._observe_e2e(e2e_s)
+            self._observe_e2e(e2e_s, exemplar=exemplar)
+            self.flight.finish(req.request_id, event="response",
+                               status="finished", http_status=resp.status)
             span.set_attribute("llm_d.e2e_ms", round(info["e2e_ms"], 3))
             span.set_attribute("http.status_code", resp.status)
             span.end()
@@ -588,6 +665,20 @@ class RouterServer:
 
     async def _health(self, request: web.Request):
         return web.json_response({"status": "ok", "endpoints": len(self.pool)})
+
+    async def _debug_requests(self, request: web.Request):
+        from llmd_tpu.obs.events import debug_list_response
+
+        status, payload = debug_list_response(self.flight,
+                                              request.rel_url.query)
+        return web.json_response(payload, status=status)
+
+    async def _debug_request(self, request: web.Request):
+        from llmd_tpu.obs.events import debug_detail_response
+
+        status, payload = debug_detail_response(self.flight,
+                                                request.match_info["rid"])
+        return web.json_response(payload, status=status)
 
     async def _models(self, request: web.Request):
         # aggregate /v1/models from one healthy endpoint

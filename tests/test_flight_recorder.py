@@ -1,0 +1,343 @@
+"""Flight recorder (ISSUE 2 tentpole): ring bounds, tail capture, engine and
+router timelines, and the /debug introspection endpoints on both servers.
+
+Covers:
+- ring-buffer eviction order and the per-request event cap;
+- SLO tail capture: retention past eviction + the force-sampled
+  ``flight.slo_breach`` span exporting even at sample_ratio=0;
+- a full arrival→admitted→prefill→first_token→retired timeline for a
+  request driven through the engine, and preempt→re-admit→retire ordering
+  under page pressure;
+- ``/debug/requests`` filtering and ``/debug/requests/<id>`` detail on BOTH
+  servers, driven over HTTP;
+- exemplar annotations on the router's TTFT/e2e histograms.
+"""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.core.request import SamplingParams
+from llmd_tpu.engine import EngineConfig, LLMEngine
+from llmd_tpu.models import get_model_config
+from llmd_tpu.obs.events import EVENT_CATALOG, FlightRecorder
+from llmd_tpu.obs.tracing import Tracer, TracingConfig
+from tests.conftest import run_async
+
+# ---------------------------------------------------------------- unit: ring
+
+
+def test_ring_eviction_order_oldest_first():
+    fr = FlightRecorder(max_requests=3)
+    for i in range(5):
+        fr.start(f"r{i}")
+    assert len(fr) == 3
+    ids = [r["request_id"] for r in fr.snapshot()]
+    assert ids == ["r4", "r3", "r2"]  # newest-first; r0/r1 evicted
+    assert fr.get("r0") is None and fr.get("r2") is not None
+
+
+def test_per_request_event_cap_counts_drops():
+    fr = FlightRecorder(max_events=4)
+    fr.start("r")
+    for i in range(10):
+        fr.record("r", "decode", n=i)
+    rec = fr.get("r")
+    assert len(rec["events"]) == 4
+    assert rec["events_dropped"] == 6
+    # terminal event bypasses the cap so the ending is never lost
+    fr.finish("r", event="retired", reason="stop")
+    rec = fr.get("r")
+    assert rec["events"][-1]["event"] == "retired"
+    assert rec["status"] == "finished" and rec["finish_reason"] == "stop"
+
+
+def test_record_unknown_request_is_noop():
+    fr = FlightRecorder()
+    fr.record("ghost", "decode")  # must not raise or create a record
+    fr.finish("ghost")
+    assert len(fr) == 0
+
+
+def test_finish_is_idempotent():
+    fr = FlightRecorder()
+    fr.start("r")
+    fr.finish("r", event="retired", reason="length")
+    e2e_first = fr.get("r")["latency_ms"]
+    fr.finish("r", event="aborted", status="aborted", reason="late")
+    rec = fr.get("r")
+    assert rec["status"] == "finished" and rec["finish_reason"] == "length"
+    assert rec["latency_ms"] == e2e_first
+
+
+def test_snapshot_filters_status_model_latency():
+    fr = FlightRecorder()
+    fr.start("a", model="tiny")
+    fr.start("b", model="tiny-mla")
+    fr.start("c", model="tiny")
+    fr.finish("c", event="retired")
+    assert [r["request_id"] for r in fr.snapshot(status="active")] == ["b", "a"]
+    assert [r["request_id"] for r in fr.snapshot(model="tiny-mla")] == ["b"]
+    assert [r["request_id"]
+            for r in fr.snapshot(status="finished")] == ["c"]
+    # min_latency uses age-so-far for active records → 0 filters nothing,
+    # a huge floor filters everything
+    assert len(fr.snapshot(min_latency_ms=0)) == 3
+    assert fr.snapshot(min_latency_ms=1e9) == []
+
+
+# -------------------------------------------------------- unit: tail capture
+
+
+def test_tail_capture_retains_past_eviction_and_force_traces():
+    tracer = Tracer(TracingConfig(enabled=True, sample_ratio=0.0,
+                                  exporter="memory"))
+    fr = FlightRecorder(max_requests=2, slo_ms=5.0, tail_keep=4,
+                        tracer=tracer)
+    fr.start("slow", model="tiny", trace_id="f" * 32)
+    fr.record("slow", "arrival")
+    time.sleep(0.02)  # e2e ≈ 20ms > 5ms SLO
+    fr.finish("slow", event="retired", reason="length")
+    assert fr.get("slow")["retained"] is True
+    # churn the ring far past capacity: the breach record must survive
+    for i in range(6):
+        fr.start(f"fast{i}")
+    assert fr.get("slow") is not None, "SLO-breach record was evicted"
+    survivors = {r["request_id"] for r in fr.snapshot()}
+    # retained records still count toward capacity (hard memory bound):
+    # eviction churned through every fast record but skipped the breach
+    assert survivors == {"slow", "fast5"} and len(fr) == 2
+    # force-sampled even though sample_ratio=0: the breach span exported
+    names = [s.name for s in tracer.spans]
+    assert "flight.slo_breach" in names
+    span = tracer.spans[names.index("flight.slo_breach")]
+    assert span.context.trace_id == "f" * 32 and span.context.sampled
+    assert span.attributes["llm_d.request_id"] == "slow"
+    assert [e["name"] for e in span.events] == ["arrival", "retired"]
+
+
+def test_tail_keep_bounds_retained_records():
+    fr = FlightRecorder(max_requests=2, slo_ms=1.0, tail_keep=2)
+    for i in range(5):
+        fr.start(f"s{i}")
+        time.sleep(0.003)
+        fr.finish(f"s{i}", event="retired")
+    retained = [r for r in fr.snapshot(limit=100) if r["retained"]]
+    assert len(retained) <= 2  # memory stays hard-bounded
+
+
+def test_no_tail_capture_when_disabled():
+    fr = FlightRecorder(max_requests=2, slo_ms=0.0)
+    fr.start("r")
+    time.sleep(0.005)
+    fr.finish("r", event="retired")
+    assert fr.get("r")["retained"] is False
+
+
+# ------------------------------------------------------------ engine timeline
+
+
+def _engine(**kw):
+    defaults = dict(page_size=8, num_pages=64, max_model_len=256,
+                    max_batch_size=4, prefill_chunk=32)
+    defaults.update(kw)
+    return LLMEngine(get_model_config("tiny"), EngineConfig(**defaults))
+
+
+def test_engine_full_timeline_ordering():
+    eng = _engine()
+    out = eng.generate([list(range(3, 40))],
+                       SamplingParams(max_tokens=6, temperature=0.0))
+    assert len(out["req-0"]) == 6
+    rec = eng.flight.get("req-0")
+    assert rec is not None and rec["status"] == "finished"
+    assert rec["finish_reason"] == "length"
+    names = [e["event"] for e in rec["events"]]
+    for ev in ("arrival", "admitted", "prefill_start", "prefill_end",
+               "first_token", "decode", "retired"):
+        assert ev in names, f"missing {ev} in {names}"
+    # lifecycle order is the timeline's contract
+    order = [names.index(e) for e in ("arrival", "admitted", "prefill_start",
+                                      "prefill_end", "first_token", "retired")]
+    assert order == sorted(order), names
+    assert names[-1] == "retired"
+    # timestamps are monotonic
+    ts = [e["t_ms"] for e in rec["events"]]
+    assert ts == sorted(ts)
+    # every emitted name is in the authoritative catalog
+    assert set(names) <= set(EVENT_CATALOG)
+
+
+def test_engine_preempt_readmit_retire_ordering():
+    """Page pressure forces preemption: a preempted request's timeline must
+    show preempted → (re-)admitted → prefill_start → retired, in order."""
+    eng = _engine(num_pages=16, max_batch_size=4,
+                  enable_prefix_caching=False)
+    prompts = [list(range(i * 7 + 1, i * 7 + 40)) for i in range(4)]
+    out = eng.generate(prompts, SamplingParams(max_tokens=12, temperature=0.0))
+    for i in range(4):
+        assert len(out[f"req-{i}"]) == 12
+    preempted = []
+    for i in range(4):
+        rec = eng.flight.get(f"req-{i}")
+        names = [e["event"] for e in rec["events"]]
+        assert rec["status"] == "finished" and names[-1] == "retired"
+        if "preempted" in names:
+            preempted.append((f"req-{i}", names))
+    assert preempted, "16-page config must preempt at least one request"
+    for rid, names in preempted:
+        i_pre = names.index("preempted")
+        tail = names[i_pre + 1:]
+        assert "admitted" in tail, f"{rid}: no re-admission after preempt"
+        # re-admission restarts prefill from the evicted pages
+        assert "prefill_start" in tail, f"{rid}: no re-prefill after preempt"
+        assert tail.index("admitted") < tail.index("prefill_start")
+
+
+def test_engine_abort_timeline():
+    from llmd_tpu.engine.engine import Sequence  # noqa: F401 (import check)
+
+    eng = _engine()
+    eng.add_request("kill-me", list(range(5, 30)),
+                    SamplingParams(max_tokens=64, temperature=0.0))
+    eng.step()  # admit + first chunk
+    eng.abort("kill-me")
+    rec = eng.flight.get("kill-me")
+    assert rec["status"] == "aborted"
+    assert [e["event"] for e in rec["events"]][-1] == "aborted"
+
+
+# ----------------------------------------------------- /debug on both servers
+
+
+async def _engine_server_scenario():
+    from llmd_tpu.engine.server import EngineServer
+
+    server = EngineServer(
+        get_model_config("tiny"),
+        EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                     max_batch_size=4, prefill_chunk=32, decode_steps=2),
+        model_name="test/tiny", host="127.0.0.1", port=0, kv_events_port=0,
+    )
+    await server.start()
+    try:
+        base = f"http://{server.address}"
+        async with aiohttp.ClientSession() as sess:
+            r = await sess.post(f"{base}/v1/completions", json={
+                "prompt": "flight recorder end to end prompt",
+                "max_tokens": 6, "temperature": 0.0, "ignore_eos": True,
+            })
+            assert r.status == 200, await r.text()
+
+            r = await sess.get(f"{base}/debug/requests")
+            assert r.status == 200
+            listing = await r.json()
+            finished = [x for x in listing["requests"]
+                        if x["status"] == "finished"]
+            assert finished, listing
+            rid = finished[0]["request_id"]
+
+            # status filter: nothing is active after the request completed
+            r = await sess.get(f"{base}/debug/requests",
+                               params={"status": "active"})
+            assert (await r.json())["requests"] == []
+            # model filter matches the engine's model config name
+            r = await sess.get(f"{base}/debug/requests",
+                               params={"model": "no-such-model"})
+            assert (await r.json())["requests"] == []
+            # bad query → 400, not a stack trace
+            r = await sess.get(f"{base}/debug/requests",
+                               params={"min_latency_ms": "bogus"})
+            assert r.status == 400
+
+            # detail: the complete arrival→retire timeline (acceptance)
+            r = await sess.get(f"{base}/debug/requests/{rid}")
+            assert r.status == 200
+            rec = await r.json()
+            names = [e["event"] for e in rec["events"]]
+            for ev in ("arrival", "admitted", "prefill_start", "prefill_end",
+                       "first_token", "retired"):
+                assert ev in names, names
+            assert names[-1] == "retired"
+            assert rec["finish_reason"] == "length"
+
+            r = await sess.get(f"{base}/debug/requests/nope")
+            assert r.status == 404
+    finally:
+        await server.stop()
+
+
+def test_engine_server_debug_endpoints():
+    run_async(_engine_server_scenario())
+
+
+async def _router_scenario():
+    from llmd_tpu.router.plugins import known_plugin_types
+    from llmd_tpu.router.server import RouterServer
+    from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+
+    cfg_text = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 1}
+"""
+    fake = FakeModelServer(FakeServerConfig())
+    await fake.start()
+    pool = EndpointPool()
+    pool.upsert(Endpoint(address=fake.address))
+    cfg = FrameworkConfig.from_yaml(cfg_text,
+                                    known_types=known_plugin_types())
+    router = RouterServer(cfg, pool, port=0, poll_interval_s=0.1)
+    await router.start()
+    try:
+        await asyncio.sleep(0.2)
+        base = f"http://{router.address}"
+        async with aiohttp.ClientSession() as sess:
+            r = await sess.post(f"{base}/v1/completions", json={
+                "prompt": "route me please", "max_tokens": 4,
+            }, headers={"x-request-id": "flight-e2e-1"})
+            assert r.status == 200, await r.text()
+
+            r = await sess.get(f"{base}/debug/requests/flight-e2e-1")
+            assert r.status == 200
+            rec = await r.json()
+            names = [e["event"] for e in rec["events"]]
+            for ev in ("arrival", "routing_decision", "forward", "response"):
+                assert ev in names, names
+            assert rec["status"] == "finished"
+            routing = rec["events"][names.index("routing_decision")]
+            assert routing["endpoint"] == fake.address
+            assert rec["trace_id"]  # span created before any flight event
+
+            # list + filters over HTTP on the router too
+            r = await sess.get(f"{base}/debug/requests",
+                               params={"status": "finished"})
+            ids = [x["request_id"] for x in (await r.json())["requests"]]
+            assert "flight-e2e-1" in ids
+            r = await sess.get(f"{base}/debug/requests",
+                               params={"min_latency_ms": "1e9"})
+            assert (await r.json())["requests"] == []
+
+            # exemplars: ttft/e2e buckets carry the trace-id annotation
+            r = await sess.get(f"{base}/metrics")
+            text = await r.text()
+            assert 'llm_d_epp_ttft_seconds_bucket' in text
+            exemplar_lines = [l for l in text.splitlines()
+                              if "# {trace_id=" in l]
+            assert any(l.startswith(("llm_d_epp_ttft_seconds_bucket",
+                                     "llm_d_epp_e2e_seconds_bucket"))
+                       for l in exemplar_lines), "no exemplar on ttft/e2e"
+    finally:
+        await router.stop()
+        await fake.stop()
+
+
+def test_router_debug_endpoints_and_exemplars():
+    run_async(_router_scenario())

@@ -13,6 +13,39 @@ def test_envvar_contract_holds():
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
+def test_event_catalog_contract_holds():
+    """Flight-recorder event names: EVENT_CATALOG, emit sites, and the
+    flight-recorder.md doc table must agree (tools/lint_events.py, CI stage
+    lint-events)."""
+    proc = subprocess.run([sys.executable, str(ROOT / "tools" / "lint_events.py")],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_event_linter_catches_unregistered_emit():
+    """An emit site using a name outside EVENT_CATALOG fails the linter."""
+    sys.path.insert(0, str(ROOT / "tools"))
+    try:
+        import lint_events
+
+        emitted = lint_events.emitted_events()
+        emitted["totally_unregistered_event"] = ["synthetic.py"]
+        orig = lint_events.emitted_events
+        lint_events.emitted_events = lambda: emitted
+        try:
+            import contextlib
+            import io
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = lint_events.main()
+        finally:
+            lint_events.emitted_events = orig
+        assert rc == 1 and "totally_unregistered_event" in buf.getvalue()
+    finally:
+        sys.path.remove(str(ROOT / "tools"))
+
+
 def test_linter_catches_undocumented_read(tmp_path):
     """The linter detects drift: an undocumented os.environ read fails it.
     (Its first real run caught 3 dead knobs shipped in the image.)"""
@@ -89,5 +122,5 @@ def test_ci_gate_composes_stages():
     summary = json.loads(proc.stdout.strip().splitlines()[-1])
     assert summary["gate"] == "ok"
     assert [s["stage"] for s in summary["stages"]] == [
-        "lint-envvars", "lint-metrics", "validate-manifests"]
+        "lint-envvars", "lint-metrics", "lint-events", "validate-manifests"]
     assert all(s["ok"] for s in summary["stages"])

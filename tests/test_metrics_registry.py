@@ -81,6 +81,32 @@ def test_histogram_cumulative_buckets_and_consistency():
     assert abs(s["t:lat_seconds_sum"] - 56.05) < 1e-9
 
 
+def test_histogram_exemplars_round_trip():
+    """OpenMetrics exemplar annotations: the latest exemplar per bucket
+    renders as `# {trace_id=...} value ts` after the bucket sample, and the
+    minimal parser still round-trips the numeric series unchanged."""
+    reg = Registry()
+    h = reg.histogram("t:ex_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05, exemplar={"trace_id": "aaa111"})
+    h.observe(0.07, exemplar={"trace_id": "bbb222"})  # same bucket: latest wins
+    h.observe(0.5)                                    # no exemplar: line bare
+    h.observe(50.0, exemplar={"trace_id": "ccc333"})  # +Inf bucket
+    text = reg.expose()
+    lines = {l.split(" ", 1)[0].split("{", 1)[1]: l
+             for l in text.splitlines() if l.startswith("t:ex_seconds_bucket")}
+    assert '# {trace_id="bbb222"} 0.07' in lines['le="0.1"}']
+    assert "aaa111" not in text
+    assert "#" not in lines['le="1"}']
+    assert '# {trace_id="ccc333"} 50' in lines['le="+Inf"}']
+    # exemplar annotations are invisible to the scrape parser
+    samples = parse_prometheus(text)
+    buckets = [(lab["le"], val) for name, lab, val in samples
+               if name == "t:ex_seconds_bucket"]
+    assert buckets == [("0.1", 2.0), ("1", 3.0), ("+Inf", 4.0)]
+    s = {name: val for name, lab, val in samples if not lab}
+    assert s["t:ex_seconds_count"] == 4
+
+
 def test_labeled_children_and_callback_values():
     reg = Registry()
     h = reg.histogram("t:d_seconds", labelnames=("phase",), buckets=(1.0,))

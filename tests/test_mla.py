@@ -214,8 +214,15 @@ def test_tp2_parity_with_replicated_latent_pool():
 
 def test_fp8_kv_single_plane_smoke():
     """fp8 pool + single-plane MLA write path (clip + convert on the shared
-    latent row): serving stays deterministic and close to the bf16 pool."""
+    latent row): serving is deterministic, and the quantized prompt KV still
+    yields the bf16 pool's argmax for the FIRST generated token — the token
+    whose logits read the whole fp8-written prefix, so a mis-scaled or
+    mis-clipped write would flip it. Later tokens feed quantized context back
+    on itself and legitimately diverge on this tiny random-weight model
+    (near-uniform logits), so no full-sequence closeness is claimed."""
     prompt = list(range(10, 42))
     a = _engine(kv_cache_dtype="fp8").generate([prompt], SamplingParams(max_tokens=5, temperature=0.0))
-    b = _engine(kv_cache_dtype="fp8").generate([prompt], SamplingParams(max_tokens=5, temperature=0.0))
-    assert a == b and len(a["req-0"]) == 5
+    a2 = _engine(kv_cache_dtype="fp8").generate([prompt], SamplingParams(max_tokens=5, temperature=0.0))
+    assert a == a2 and len(a["req-0"]) == 5
+    ref = _engine().generate([prompt], SamplingParams(max_tokens=5, temperature=0.0))
+    assert a["req-0"][0] == ref["req-0"][0]

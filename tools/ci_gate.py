@@ -54,6 +54,7 @@ def main() -> int:
     stages = [
         ("lint-envvars", [py, "tools/lint_envvars.py"], None),
         ("lint-metrics", [py, "tools/lint_metrics.py"], CPU_ENV),
+        ("lint-events", [py, "tools/lint_events.py"], CPU_ENV),
         ("validate-manifests", [py, "tools/validate_manifests.py", "deploy"], None),
     ]
     if not args.skip_tests:

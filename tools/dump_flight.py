@@ -1,0 +1,149 @@
+"""Render flight-recorder timelines from a live server or a dump file.
+
+The flight recorder (llmd_tpu/obs/events.py) keeps a bounded ring of
+per-request event timelines on both the router and every engine pod,
+exposed at ``/debug/requests`` (summaries) and ``/debug/requests/<id>``
+(full timeline). This CLI renders either view human-readably, from a live
+server URL or from a previously saved JSON dump (``--save`` writes one).
+
+Usage:
+  # list recent requests on a live server (router or engine pod)
+  python tools/dump_flight.py http://localhost:8000
+
+  # filter: slow finished requests only
+  python tools/dump_flight.py http://localhost:8000 \
+      --status finished --min-latency-ms 500 --limit 20
+
+  # one request's full timeline
+  python tools/dump_flight.py http://localhost:8000 --id 1a2b3c...
+
+  # snapshot to a file, render offline later
+  python tools/dump_flight.py http://localhost:8000 --save flight.json
+  python tools/dump_flight.py flight.json
+  python tools/dump_flight.py flight.json --id 1a2b3c...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.parse
+import urllib.request
+
+
+def _fetch(url: str, timeout: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _load(source: str, args: argparse.Namespace) -> dict:
+    """Source is a server base URL or a dump-file path. Returns the
+    ``/debug/requests`` list payload shape: {"requests": [...], "system": [...]}
+    (single-record dumps are wrapped)."""
+    if source.startswith("http://") or source.startswith("https://"):
+        base = source.rstrip("/")
+        if args.id:
+            rec = _fetch(f"{base}/debug/requests/{urllib.parse.quote(args.id)}",
+                         args.timeout)
+            return {"requests": [rec], "system": []}
+        query = {}
+        if args.status:
+            query["status"] = args.status
+        if args.model:
+            query["model"] = args.model
+        if args.min_latency_ms is not None:
+            query["min_latency_ms"] = str(args.min_latency_ms)
+        query["limit"] = str(args.limit)
+        qs = urllib.parse.urlencode(query)
+        return _fetch(f"{base}/debug/requests?{qs}", args.timeout)
+    with open(source) as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "requests" in data:
+        return data
+    if isinstance(data, list):
+        return {"requests": data, "system": []}
+    return {"requests": [data], "system": []}  # single-record dump
+
+
+def _fmt_attrs(ev: dict) -> str:
+    return " ".join(f"{k}={ev[k]}" for k in ev
+                    if k not in ("event", "t_ms", "t_unix"))
+
+
+def render_timeline(rec: dict, out=sys.stdout) -> None:
+    print(f"request {rec.get('request_id')}  model={rec.get('model') or '-'}  "
+          f"status={rec.get('status')}  latency={rec.get('latency_ms')}ms  "
+          f"trace={rec.get('trace_id') or '-'}", file=out)
+    if rec.get("finish_reason"):
+        print(f"  finish_reason: {rec['finish_reason']}", file=out)
+    if rec.get("events_dropped"):
+        print(f"  ({rec['events_dropped']} events dropped past the "
+              f"per-request cap)", file=out)
+    for ev in rec.get("events", []):
+        print(f"  {ev['t_ms']:>10.3f}ms  {ev['event']:<18} {_fmt_attrs(ev)}",
+              file=out)
+
+
+def render_list(payload: dict, out=sys.stdout) -> None:
+    rows = payload.get("requests", [])
+    if not rows:
+        print("no requests recorded", file=out)
+        return
+    print(f"{'request_id':<34} {'model':<12} {'status':<10} "
+          f"{'latency_ms':>11} {'events':>6}  finish_reason", file=out)
+    for r in rows:
+        print(f"{r.get('request_id', ''):<34} {r.get('model') or '-':<12} "
+              f"{r.get('status', ''):<10} {r.get('latency_ms', 0):>11.1f} "
+              f"{r.get('n_events', 0):>6}  {r.get('finish_reason') or ''}",
+              file=out)
+    system = payload.get("system", [])
+    if system:
+        print(f"\nsystem events ({len(system)}):", file=out)
+        for ev in system[-20:]:
+            print(f"  t={ev.get('t_unix')}  {ev['event']:<12} "
+                  f"{_fmt_attrs(ev)}", file=out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render flight-recorder request timelines")
+    ap.add_argument("source",
+                    help="server base URL (http://host:port) or dump file")
+    ap.add_argument("--id", help="render one request's full timeline")
+    ap.add_argument("--status",
+                    help="filter: active|finished|aborted|rejected|error")
+    ap.add_argument("--model", help="filter by model name")
+    ap.add_argument("--min-latency-ms", type=float, default=None,
+                    help="filter: e2e (or age-so-far) at least this")
+    ap.add_argument("--limit", type=int, default=100)
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--save", metavar="PATH",
+                    help="write the raw JSON payload to PATH instead of "
+                         "rendering")
+    args = ap.parse_args(argv)
+
+    try:
+        payload = _load(args.source, args)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if args.save:
+        with open(args.save, "w") as f:
+            json.dump(payload, f, indent=2)
+        print(f"wrote {args.save}")
+        return 0
+    if args.id:
+        recs = [r for r in payload["requests"]
+                if r.get("request_id") == args.id] or payload["requests"][:1]
+        if not recs:
+            print(f"error: request {args.id!r} not found", file=sys.stderr)
+            return 1
+        render_timeline(recs[0])
+    else:
+        render_list(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
