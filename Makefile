@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests
+.PHONY: check check-quick test bench dryrun lint manifests chaos
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -25,6 +25,10 @@ manifests:
 
 bench:
 	$(PY) bench.py --tiny --cpu
+
+# router resilience vs fault-injected endpoints (goodput >= 99%, no 5xx)
+chaos:
+	JAX_PLATFORMS=cpu $(PY) tools/chaos_check.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

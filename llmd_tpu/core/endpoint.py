@@ -85,6 +85,26 @@ class Endpoint:
         v = self.attrs.get(key)
         return default if v is None else float(v)
 
+    def mark_scrape_failed(self) -> None:
+        """Called by the metrics poller when this endpoint's scrape fails: the
+        last-known metrics stay readable but are flagged stale so consumers
+        (breaker passive health, /v1/models aggregation) can discount them."""
+        self.attrs.put("scrape_failed", True)
+
+    def mark_scrape_ok(self) -> None:
+        self.attrs.put("scrape_failed", False)
+        self.attrs.put("last_poll_ok", time.monotonic())
+
+    def stale(self, max_age_s: float = 10.0) -> bool:
+        """True when the last scrape failed, or no successful scrape landed
+        within ``max_age_s`` (and at least one scrape was ever attempted —
+        a never-polled endpoint, e.g. unit tests without a poller, is not
+        stale)."""
+        if self.attrs.get("scrape_failed"):
+            return True
+        age = self.attrs.age("last_poll_ok")
+        return age != float("inf") and age > max_age_s
+
     def __hash__(self) -> int:
         return hash(self.address)
 

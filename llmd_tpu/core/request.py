@@ -23,6 +23,11 @@ HDR_MODEL_REWRITE = "x-llm-d-model-name-rewrite"
 HDR_SLO_TTFT_MS = "x-llm-d-slo-ttft-ms"
 HDR_SLO_TPOT_MS = "x-llm-d-slo-tpot-ms"
 HDR_PREFILLER_HOST_PORT = "x-prefiller-host-port"
+# End-to-end deadline contract (observability/resilience.md): seconds of total
+# budget. The router decrements it across flow-control wait + scheduling and
+# forwards the REMAINDER under the same name, so the engine always sees how
+# much budget the client has left, not the original figure.
+HDR_REQUEST_TIMEOUT = "x-request-timeout"
 
 
 def media_url_of_part(part: Any) -> "tuple[Optional[str], Optional[str]]":
@@ -159,6 +164,7 @@ class RequestOutcome(str, Enum):
     EVICTED_TTL = "evicted_ttl"  # → 503
     EVICTED_DISCONNECT = "evicted_disconnect"  # → 503
     EVICTED_SHUTDOWN = "evicted_shutdown"  # → 500
+    EVICTED_DEADLINE = "evicted_deadline"  # → 504 (client budget spent in queue)
 
     @property
     def http_status(self) -> int:
@@ -168,6 +174,7 @@ class RequestOutcome(str, Enum):
             RequestOutcome.EVICTED_TTL: 503,
             RequestOutcome.EVICTED_DISCONNECT: 503,
             RequestOutcome.EVICTED_SHUTDOWN: 500,
+            RequestOutcome.EVICTED_DEADLINE: 504,
         }[self]
 
 
@@ -196,6 +203,10 @@ class InferenceRequest:
     priority: int = 0
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
+    # Total end-to-end budget in seconds (x-request-timeout header or router
+    # default). The deadline is absolute: arrival_time + timeout_s, so queueing
+    # and scheduling time decrement the budget without extra bookkeeping.
+    timeout_s: Optional[float] = None
     lora_adapter: Optional[str] = None
     # Multimodal content hashes folded into block keys (kv-indexer.md:146-151).
     mm_hashes: list[bytes] = field(default_factory=list)
@@ -216,6 +227,19 @@ class InferenceRequest:
     def flow_key(self) -> tuple[str, int]:
         return (self.fairness_id, self.priority)
 
+    def deadline(self) -> Optional[float]:
+        """Absolute monotonic deadline, or None when no budget was set."""
+        if self.timeout_s is None:
+            return None
+        return self.arrival_time + self.timeout_s
+
+    def remaining_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Budget left (may be negative once expired); None = unbounded."""
+        dl = self.deadline()
+        if dl is None:
+            return None
+        return dl - (time.monotonic() if now is None else now)
+
     @classmethod
     def from_headers(cls, headers: dict[str, str], **kw: Any) -> "InferenceRequest":
         req = cls(**kw)
@@ -230,4 +254,12 @@ class InferenceRequest:
                     setattr(req, attr, float(raw))
                 except ValueError:
                     pass
+        raw = get(HDR_REQUEST_TIMEOUT)
+        if raw:
+            try:
+                t = float(raw)
+                if t > 0:
+                    req.timeout_s = t
+            except ValueError:
+                pass
         return req

@@ -27,7 +27,11 @@ from typing import Optional
 from aiohttp import web
 
 from llmd_tpu.core.kv_events import KVEvent, encode_event_batch, kv_topic
-from llmd_tpu.core.request import SamplingParams, flatten_messages
+from llmd_tpu.core.request import (
+    HDR_REQUEST_TIMEOUT,
+    SamplingParams,
+    flatten_messages,
+)
 from llmd_tpu.disagg.transfer import (
     KVTransferParams,
     export_begin,
@@ -116,6 +120,9 @@ class EngineServer:
             self.async_engine = AsyncLLMEngine(self.engine)
         self._runner: Optional[web.AppRunner] = None
         self.request_count = 0
+        # graceful drain (POST /drain): admissions stop, in-flight requests
+        # finish, /health reports draining so the router routes around us
+        self._draining = False
         self._vision = None  # lazy in-process vision tower (combined-PD mode)
         self._vision_lock = __import__("threading").Lock()  # one compile, ever
         # Conversations API store (pod-local; router keeps traffic sticky by
@@ -210,6 +217,7 @@ class EngineServer:
         app.router.add_post("/v1/chat/completions/render", self._render)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
+        app.router.add_post("/drain", self._drain)
         app.router.add_get("/v1/models", self._models)
         app.router.add_post("/v1/load_lora_adapter", self._load_lora)
         app.router.add_post("/v1/unload_lora_adapter", self._unload_lora)
@@ -378,6 +386,25 @@ class EngineServer:
         return token_ids, mm_items
 
     # -- handlers ----------------------------------------------------------
+    def _admission_block(self, request: web.Request) -> Optional[web.Response]:
+        """Shared admission gate: draining → 503 (retryable, so the router
+        re-schedules the request on another endpoint); an already-expired
+        forwarded deadline (x-request-timeout remainder ≤ 0) → 504 before any
+        tokenization or engine work is spent on it."""
+        if self._draining:
+            return web.json_response({"error": {"message": "draining"}},
+                                     status=503, headers={"Retry-After": "1"})
+        raw = request.headers.get(HDR_REQUEST_TIMEOUT)
+        if raw is not None:
+            try:
+                budget = float(raw)
+            except ValueError:
+                return None  # malformed header: ignore, don't reject
+            if budget <= 0:
+                return web.json_response(
+                    {"error": {"message": "deadline exceeded"}}, status=504)
+        return None
+
     async def _completions(self, request: web.Request):
         return await self._generate(request, chat=False)
 
@@ -385,6 +412,9 @@ class EngineServer:
         return await self._generate(request, chat=True)
 
     async def _generate(self, request: web.Request, chat: bool):
+        blocked = self._admission_block(request)
+        if blocked is not None:
+            return blocked
         try:
             body = await request.json()
         except Exception:
@@ -536,6 +566,9 @@ class EngineServer:
     async def _embeddings(self, request: web.Request):
         """OpenAI /v1/embeddings: mean-pooled L2-normalised final hidden states
         (openai-parser endpoint list, request-handling.md:50-73)."""
+        blocked = self._admission_block(request)
+        if blocked is not None:
+            return blocked
         try:
             body = await request.json()
         except Exception:
@@ -598,6 +631,9 @@ class EngineServer:
         """OpenAI Responses API (epp-http-apis.md:153-183): ``input`` + optional
         ``conversation`` id; conversation context prepends, and the exchange is
         appended back to the store."""
+        blocked = self._admission_block(request)
+        if blocked is not None:
+            return blocked
         try:
             body = await request.json()
         except Exception:
@@ -770,7 +806,45 @@ class EngineServer:
             text=self.engine.registry.expose() + self.registry.expose())
 
     async def _health(self, request: web.Request):
+        if self._draining:
+            # 503 = readiness-probe semantics: load balancers drop us from
+            # rotation while the in-flight tail finishes
+            return web.json_response(
+                {"status": "draining", "inflight": len(self.engine.seqs)},
+                status=503)
         return web.json_response({"status": "ok"})
+
+    async def _drain(self, request: web.Request):
+        """POST /drain[?timeout_s=30] — stop admissions, wait for in-flight
+        requests to finish (bounded), report the result. ``{"enable": false}``
+        in the body re-opens admissions (rollback of an aborted drain)."""
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        if body.get("enable") is False:
+            self._draining = False
+            return web.json_response({"status": "ok", "draining": False})
+        try:
+            timeout_s = float(request.query.get("timeout_s", 30.0))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "timeout_s must be a number"}}, status=400)
+        t0 = time.monotonic()
+        if not self._draining:
+            self._draining = True
+            self.engine.flight.record_system(
+                "drain_start", inflight=len(self.engine.seqs))
+        while self.engine.seqs and time.monotonic() - t0 < timeout_s:
+            await asyncio.sleep(0.02)
+        drained = not self.engine.seqs
+        self.engine.flight.record_system(
+            "drain_done", drained=drained, inflight=len(self.engine.seqs),
+            waited_ms=round((time.monotonic() - t0) * 1e3, 1))
+        return web.json_response(
+            {"status": "drained" if drained else "timeout",
+             "inflight": len(self.engine.seqs)},
+            status=200 if drained else 504)
 
     async def _debug_requests(self, request: web.Request):
         from llmd_tpu.obs.events import debug_list_response

@@ -52,6 +52,12 @@ EVENT_CATALOG = (
     "response",
     "rejected",
     "error",
+    # router resilience plane (router/resilience.py + server retry loop)
+    "deadline_exceeded",
+    "retry",
+    "hedge",
+    "breaker_open",
+    "breaker_close",
     # engine plane
     "admitted",
     "prefill_start",
@@ -63,6 +69,8 @@ EVENT_CATALOG = (
     "kv_offload",
     "retired",
     "aborted",
+    "drain_start",
+    "drain_done",
 )
 
 _TERMINAL_STATUS = {"finished", "aborted", "rejected", "error"}

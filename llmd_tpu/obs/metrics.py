@@ -544,6 +544,9 @@ class RouterMetrics:
         self.flow_evicted_ttl = reg.counter(
             "llm_d_epp_flow_evicted_ttl_total",
             "Queued requests evicted on TTL expiry")
+        self.flow_evicted_deadline = reg.counter(
+            "llm_d_epp_flow_evicted_deadline_total",
+            "Queued requests whose client deadline expired before dispatch")
         self.flow_queue_depth = reg.gauge(
             "llm_d_epp_flow_queue_depth",
             "Requests currently waiting in flow-control queues")
@@ -567,6 +570,35 @@ class RouterMetrics:
         self.e2e = reg.histogram(
             "llm_d_epp_e2e_seconds", "End-to-end request latency",
             buckets=(0.1, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0))
+        # Resilience layer (router/resilience.py, observability/resilience.md)
+        self.retries = reg.counter(
+            "llm_d_epp_retries_total",
+            "Forward attempts retried on an alternate endpoint, by reason",
+            labelnames=("reason",))
+        self.retries_exhausted = reg.counter(
+            "llm_d_epp_retries_exhausted_total",
+            "Requests that failed after exhausting every retry attempt")
+        self.breaker_opens = reg.counter(
+            "llm_d_epp_breaker_opens_total",
+            "Per-endpoint circuit breakers tripped open")
+        self.breaker_closes = reg.counter(
+            "llm_d_epp_breaker_closes_total",
+            "Circuit breakers closed after successful half-open probes")
+        self.breaker_open_endpoints = reg.gauge(
+            "llm_d_epp_breaker_open_endpoints",
+            "Endpoints currently ejected by an open circuit breaker")
+        self.deadline_exceeded = reg.counter(
+            "llm_d_epp_deadline_exceeded_total",
+            "Requests rejected 504 because the client budget ran out in the router")
+        self.hedges = reg.counter(
+            "llm_d_epp_hedges_total",
+            "Hedged second attempts fired for short non-streaming requests")
+        self.hedge_wins = reg.counter(
+            "llm_d_epp_hedge_wins_total",
+            "Hedged attempts that answered before the primary")
+        self.scrape_errors = reg.counter(
+            "llm_d_epp_scrape_errors_total",
+            "Endpoint metrics scrapes that failed (passive-health signal)")
 
 
 def register_engine_metrics(reg: Registry) -> EngineMetrics:

@@ -107,6 +107,12 @@ class MetricsPoller:
         self._task: Optional[asyncio.Task] = None
         self.poll_count = 0
         self.error_counts: dict[str, int] = {}
+        # scrape transport failures only (llm_d_epp_scrape_errors_total feeds
+        # off this; extractor bugs stay in error_counts and don't inflate it)
+        self.scrape_error_count = 0
+        # resilience hook: called with the endpoint address on each scrape
+        # failure — the breaker's passive-health signal (router attaches it)
+        self.on_scrape_error = None
 
     async def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())
@@ -139,9 +145,20 @@ class MetricsPoller:
                         key = f"{ep.address}:{ext.name}"
                         self.error_counts[key] = self.error_counts.get(key, 0) + 1
                 if all_ok:
-                    ep.attrs.put("last_poll_ok", time.monotonic())
+                    ep.mark_scrape_ok()
             except Exception:
+                # Scrape transport failure: the last-known metrics would
+                # otherwise look fresh forever — flag the endpoint stale so
+                # consumers (breaker passive health, /v1/models aggregation)
+                # stop trusting it, and surface the failure as a counter.
                 self.error_counts[ep.address] = self.error_counts.get(ep.address, 0) + 1
+                self.scrape_error_count += 1
+                ep.mark_scrape_failed()
+                if self.on_scrape_error is not None:
+                    try:
+                        self.on_scrape_error(ep.address)
+                    except Exception:
+                        pass  # the hook must never kill the poll loop
 
         await asyncio.gather(*(one(e) for e in self.pool.list()))
         self.poll_count += 1

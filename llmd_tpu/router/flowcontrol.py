@@ -144,18 +144,26 @@ class PriorityBand:
             return item
         return None
 
-    def evict_expired(self, now: float) -> list[QueuedItem]:
-        out = []
+    def evict_expired(self, now: float) -> list[tuple[QueuedItem, str]]:
+        """Drop TTL-expired and deadline-expired items; returns (item, why)
+        with why in {"ttl", "deadline"}. A request whose client budget ran
+        out while queued must NOT dispatch with a stale budget — it gets a
+        504 here instead of timing out downstream after wasting an endpoint."""
+        out: list[tuple[QueuedItem, str]] = []
         for fid in list(self.flows):
             q = self.flows[fid]
             keep: deque[QueuedItem] = deque()
             for item in q:
-                if now - item.enqueue_time > self.spec.ttl_s:
-                    out.append(item)
-                    self.bytes -= item.byte_size
-                    self.count -= 1
+                dl = item.req.deadline()
+                if dl is not None and now >= dl:
+                    out.append((item, "deadline"))
+                elif now - item.enqueue_time > self.spec.ttl_s:
+                    out.append((item, "ttl"))
                 else:
                     keep.append(item)
+                    continue
+                self.bytes -= item.byte_size
+                self.count -= 1
             if keep:
                 self.flows[fid] = keep
             else:
@@ -185,7 +193,7 @@ class FlowController:
         self._wake = asyncio.Event()
         self.metrics = {
             "enqueued_total": 0, "dispatched_total": 0, "rejected_capacity_total": 0,
-            "evicted_ttl_total": 0, "queue_depth": 0,
+            "evicted_ttl_total": 0, "evicted_deadline_total": 0, "queue_depth": 0,
         }
         # obs.metrics Histogram observing enqueue→dispatch wait; attached by
         # the router (llm_d_epp_flow_queue_wait_seconds), None standalone
@@ -196,6 +204,15 @@ class FlowController:
 
     # -- API ---------------------------------------------------------------
     async def enqueue_and_wait(self, req: InferenceRequest) -> RequestOutcome:
+        rem = req.remaining_s()
+        if rem is not None and rem <= 0:
+            # budget already spent before queueing (tiny client timeout or a
+            # slow parse): don't occupy queue capacity just to evict it later
+            self.metrics["evicted_deadline_total"] += 1
+            if self.flight is not None:
+                self.flight.record(req.request_id, "deadline_exceeded",
+                                   where="flow_enqueue")
+            return RequestOutcome.EVICTED_DEADLINE
         band = self.bands.get(req.priority)
         if band is None:
             # snap to nearest lower band, else lowest
@@ -245,7 +262,17 @@ class FlowController:
                 await self._wake.wait()
             now = time.monotonic()
             for band in self.bands.values():
-                for item in band.evict_expired(now):
+                for item, why in band.evict_expired(now):
+                    if why == "deadline":
+                        self.metrics["evicted_deadline_total"] += 1
+                        if self.flight is not None:
+                            self.flight.record(
+                                item.req.request_id, "deadline_exceeded",
+                                where="flow_control",
+                                waited_ms=round((now - item.enqueue_time) * 1e3, 3))
+                        if not item.future.done():
+                            item.future.set_result(RequestOutcome.EVICTED_DEADLINE)
+                        continue
                     self.metrics["evicted_ttl_total"] += 1
                     if self.flight is not None:
                         self.flight.record(

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from llmd_tpu.core.config import FrameworkConfig
 from llmd_tpu.core.endpoint import Endpoint, EndpointPool
@@ -99,11 +99,23 @@ class Scheduler:
         raw_fc = config.raw.get("disaggregation", {}) or {}
         self.pd_threshold_tokens = int(raw_fc.get("uncachedSuffixThreshold", 0))
         self.metrics = {"scheduled_total": 0, "rejected_total": 0, "pd_splits_total": 0}
+        # Resilience hook (router/resilience.py): filters breaker-open and
+        # draining endpoints out of every pick. None = no filtering.
+        self.endpoint_filter: Optional[Callable[[list[Endpoint]], list[Endpoint]]] = None
 
     # ------------------------------------------------------------------
-    def schedule(self, req: InferenceRequest) -> SchedulingResult:
+    def schedule(self, req: InferenceRequest,
+                 exclude: Optional[set[str]] = None) -> SchedulingResult:
+        """Pick endpoint(s) for ``req``. ``exclude`` holds addresses already
+        tried this request (retry re-pick, llm-d ``excluded_runner_ids``
+        semantics) — they are removed BEFORE the resilience filter so the
+        fail-open backstop cannot hand back an endpoint that just failed."""
         t0 = time.monotonic()
         endpoints = self.pool.list()
+        if exclude:
+            endpoints = [e for e in endpoints if e.address not in exclude]
+        if self.endpoint_filter is not None and endpoints:
+            endpoints = self.endpoint_filter(endpoints)
         if not endpoints:
             return SchedulingResult(None, rejected="no endpoints")
         for p in self.producers:

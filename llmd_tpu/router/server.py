@@ -27,12 +27,18 @@ from llmd_tpu.core.config import FrameworkConfig
 from llmd_tpu.core.endpoint import EndpointPool
 from llmd_tpu.core.request import (
     HDR_PREFILLER_HOST_PORT,
+    HDR_REQUEST_TIMEOUT,
     InferenceRequest,
     RequestOutcome,
     SamplingParams,
 )
 from llmd_tpu.router.datalayer import MetricsPoller
 from llmd_tpu.router.flowcontrol import FlowController
+from llmd_tpu.router.resilience import (
+    RETRYABLE_STATUSES,
+    ResilienceConfig,
+    ResilienceManager,
+)
 from llmd_tpu.router.scheduler import Scheduler
 from llmd_tpu.router.scorers import STATE_TOKEN_IDS
 
@@ -224,6 +230,21 @@ class RouterServer:
         self.flight = FlightRecorder.from_env(tracer=self.tracer)
         if self.flow is not None:
             self.flow.flight = self.flight
+            self.metrics.flow_evicted_deadline.set_function(
+                lambda: self.flow.metrics["evicted_deadline_total"])
+        # Resilience layer (router/resilience.py): deadlines, retries, per-
+        # endpoint circuit breakers, drain awareness, hedging. The breaker
+        # filter hooks into every scheduler pick; the poller's scrape failures
+        # feed it as a passive-health signal.
+        self.resilience = ResilienceManager(
+            ResilienceConfig.from_env(), metrics=self.metrics,
+            flight=self.flight)
+        self.scheduler.endpoint_filter = self.resilience.filter_endpoints
+        self.poller.on_scrape_error = self.resilience.note_scrape_error
+        self.metrics.scrape_errors.set_function(
+            lambda: self.poller.scrape_error_count)
+        self.metrics.breaker_open_endpoints.set_function(
+            lambda: len(self.resilience.open_endpoints()))
         # extra Prometheus providers (ext-proc EPP front, HA coordinator, ...):
         # callables returning lines, appended to /metrics
         self.extra_metrics: list[Any] = []
@@ -357,6 +378,10 @@ class RouterServer:
         req.request_id = lower.get("x-request-id", uuid.uuid4().hex)
         if req.objective and req.objective in self.objectives:
             req.priority = self.objectives[req.objective]
+        if req.timeout_s is None:
+            # no client deadline header: the router default still bounds every
+            # attempt (replacing the old hard-coded 600s forward timeout)
+            req.timeout_s = self.resilience.cfg.request_timeout_s
         self._rewrite_model(req, body)
         return req
 
@@ -387,13 +412,113 @@ class RouterServer:
             await p.aproduce(req, self.pool.list(), self._session)
         if span:
             span.add_event("schedule.start")
-        result = await asyncio.get_running_loop().run_in_executor(
-            self._sched_executor, self.scheduler.schedule, req
-        )
+        result = await self._schedule(req)
         if result.endpoint is None:
             self.metrics.errors.inc()
             return None, Rejection(503, f"no endpoint: {result.rejected}")
+        rem = req.remaining_s()
+        if rem is not None and rem <= 0:
+            # flow wait + scheduling ate the whole client budget: a 504 now is
+            # honest; dispatching with a stale budget just wastes an endpoint
+            self.metrics.deadline_exceeded.inc()
+            self.flight.record(req.request_id, "deadline_exceeded",
+                               where="post_schedule")
+            return None, Rejection(504, "deadline exceeded before dispatch",
+                                   deliberate=True)
         return result, None
+
+    async def _schedule(self, req: InferenceRequest,
+                        exclude: Optional[set] = None):
+        """Scheduler pick on the single worker thread; ``exclude`` holds
+        endpoints already tried this request (retry/hedge re-pick)."""
+        return await asyncio.get_running_loop().run_in_executor(
+            self._sched_executor, self.scheduler.schedule, req, exclude)
+
+    def _note_outcome(self, address: str, status: int) -> None:
+        """Feed a completed response into the breaker: any 5xx is a failure
+        signal, everything else (including 4xx client errors) proves the
+        endpoint's serving path works."""
+        if status >= 500:
+            self.resilience.on_failure(address, reason=f"http {status}")
+        else:
+            self.resilience.on_success(address)
+
+    async def _post_maybe_hedged(self, req: InferenceRequest, target,
+                                 path: str, body, fwd_headers: dict,
+                                 timeout_s: float, first_attempt: bool):
+        """POST to ``target``; on the first attempt of a hedge-eligible
+        request, race a delayed second attempt on another endpoint ("The Tail
+        at Scale" hedging). Returns ``(response, endpoint_that_answered)``;
+        raises the transport error when every leg fails."""
+        timeout = aiohttp.ClientTimeout(total=timeout_s)
+
+        def post(ep):
+            return self._session.post(f"http://{ep.address}{path}", json=body,
+                                      headers=fwd_headers, timeout=timeout)
+
+        if not first_attempt or not self.resilience.hedge_eligible(req):
+            return await post(target), target
+        primary = asyncio.ensure_future(post(target))
+        delay = self.resilience.hedge_delay_s()
+        done, _ = await asyncio.wait({primary}, timeout=delay)
+        if primary in done:
+            return primary.result(), target  # under the hedge delay: no hedge
+        alt = await self._schedule(req, {target.address})
+        if alt.endpoint is None:
+            return await primary, target  # nowhere to hedge to
+        self.metrics.hedges.inc()
+        self.flight.record(req.request_id, "hedge", primary=target.address,
+                           secondary=alt.endpoint.address,
+                           delay_ms=round(delay * 1e3, 3))
+        secondary = asyncio.ensure_future(post(alt.endpoint))
+        legs = {primary: target, secondary: alt.endpoint}
+        pending = set(legs)
+        winner = None  # first leg answering with a non-5xx
+        # a 5xx leg is kept as fallback: returned unconsumed if nothing wins
+        # so the caller's retry loop can judge its (retryable) status
+        fallback = None
+        error = None
+        while pending and winner is None:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                try:
+                    r = t.result()
+                except Exception as e:
+                    error = e
+                    continue
+                if r.status < 500 and winner is None:
+                    winner = t
+                elif fallback is None:
+                    fallback = t
+                else:
+                    r.release()
+        chosen = winner if winner is not None else fallback
+        for t, ep in legs.items():
+            if t is chosen:
+                continue
+            if not t.done():
+                t.cancel()
+            asyncio.ensure_future(self._reap_leg(t))
+            # the loser's pick also ran pre_request: settle its producer
+            # bookkeeping here (the caller only settles the returned leg);
+            # when both legs fail, the caller reports the primary itself
+            if chosen is not None or t is secondary:
+                self.scheduler.post_response(req, ep, {"hedge_loser": True})
+        if chosen is None:
+            raise error
+        if chosen is secondary and winner is not None:
+            self.metrics.hedge_wins.inc()
+        return chosen.result(), legs[chosen]
+
+    @staticmethod
+    async def _reap_leg(task) -> None:
+        """Release a cancelled/abandoned hedge leg's connection quietly."""
+        try:
+            r = await task
+        except BaseException:
+            return
+        r.release()
 
     def _sticky_endpoint(self, conversation_id: str):
         """Conversation→pod mapping: rendezvous (highest-random-weight) hashing,
@@ -506,11 +631,29 @@ class RouterServer:
                                endpoint=target.address, sticky=True)
             self.flight.record(req.request_id, "forward",
                                endpoint=target.address)
+            rem = req.remaining_s()
+            budget = (rem if rem is not None
+                      else self.resilience.cfg.request_timeout_s)
+            if budget <= 0:
+                self.metrics.deadline_exceeded.inc()
+                self.flight.record(req.request_id, "deadline_exceeded",
+                                   where="sticky")
+                self.flight.finish(req.request_id, event="rejected",
+                                   status="rejected",
+                                   reason="deadline exceeded", http_status=504)
+                span.set_error("deadline exceeded")
+                span.end()
+                return web.json_response(
+                    {"error": {"message": "deadline exceeded"}}, status=504)
             resp = await self._forward_sticky(
-                target, "POST", request.path, body, timeout_s=600,
+                target, "POST", request.path, body, timeout_s=budget,
                 fwd_headers={"content-type": "application/json",
                              "traceparent": span.traceparent(),
-                             "x-request-id": req.request_id})
+                             "x-request-id": req.request_id,
+                             HDR_REQUEST_TIMEOUT: f"{budget:.3f}"})
+            # sticky traffic can't route around its pod, but its outcomes
+            # still teach the breaker (protects the scheduled path)
+            self._note_outcome(target.address, resp.status)
             if resp.status >= 500:
                 self.flight.finish(req.request_id, event="error",
                                    status="error", http_status=resp.status)
@@ -552,35 +695,109 @@ class RouterServer:
         self.flight.record(req.request_id, "forward",
                            endpoint=result.endpoint.address)
 
-        fwd_headers = {"content-type": "application/json",
-                       "traceparent": span.traceparent(),
-                       "x-request-id": req.request_id}
-        if result.prefill_endpoint is not None:
-            fwd_headers[HDR_PREFILLER_HOST_PORT] = result.prefill_endpoint.address
         target = result.endpoint
-
-        try:
-            resp = await self._session.post(
-                f"http://{target.address}{request.path}", json=body, headers=fwd_headers,
-                timeout=aiohttp.ClientTimeout(total=600),
-            )
-        except Exception as e:
+        prefill = result.prefill_endpoint
+        # Bounded retry loop: connect errors, attempt timeouts, and retryable
+        # statuses (502/503/504) BEFORE any response body re-schedule on a
+        # different endpoint (excluded set = llm-d excluded_runner_ids). Once
+        # a non-retryable response arrives the request is committed to it.
+        excluded = {target.address}
+        attempt = 1
+        resp = None
+        while True:
+            rem = req.remaining_s()
+            if rem is not None and rem <= 0:
+                self.metrics.deadline_exceeded.inc()
+                self.flight.record(req.request_id, "deadline_exceeded",
+                                   where="retry_loop", attempts=attempt - 1)
+                self.flight.finish(req.request_id, event="rejected",
+                                   status="rejected",
+                                   reason="deadline exceeded",
+                                   http_status=504)
+                span.set_error("deadline exceeded")
+                span.end()
+                return web.json_response(
+                    {"error": {"message": "deadline exceeded"}}, status=504)
+            budget = rem if rem is not None else self.resilience.cfg.request_timeout_s
+            fwd_headers = {"content-type": "application/json",
+                           "traceparent": span.traceparent(),
+                           "x-request-id": req.request_id,
+                           # the engine sees the REMAINING budget, not the
+                           # client's original: queue wait already spent it
+                           HDR_REQUEST_TIMEOUT: f"{budget:.3f}"}
+            if prefill is not None:
+                fwd_headers[HDR_PREFILLER_HOST_PORT] = prefill.address
+            failure = None  # (kind, detail) when this attempt failed retryably
+            try:
+                resp, target = await self._post_maybe_hedged(
+                    req, target, request.path, body, fwd_headers, budget,
+                    first_attempt=(attempt == 1))
+            except asyncio.TimeoutError:
+                failure = ("timeout", f"attempt timeout after {budget:.3f}s")
+            except Exception as e:
+                failure = ("connect", f"{type(e).__name__}: {e}")
+            if failure is None and resp.status in RETRYABLE_STATUSES:
+                failure = ("status", f"http {resp.status}")
+                resp.release()
+            if failure is None:
+                break  # response committed (headers in, not retryable)
+            kind, detail = failure
             self.metrics.errors.inc()
-            self.scheduler.post_response(req, target, {"error": str(e)})
-            self.flight.finish(req.request_id, event="error", status="error",
-                               reason=f"upstream error: {e}", http_status=502)
-            span.set_error(f"upstream error: {e}")
-            span.end()
-            return web.json_response(
-                {"error": {"message": f"upstream error: {e}"}}, status=502
-            )
+            self.resilience.on_failure(target.address, reason=detail)
+            # every pick ran pre_request: failed attempts still owe producers
+            # their post_response so inflight bookkeeping stays balanced
+            self.scheduler.post_response(req, target, {"error": detail})
+            if attempt >= self.resilience.cfg.retry_max_attempts:
+                self.metrics.retries_exhausted.inc()
+                self.flight.finish(req.request_id, event="error",
+                                   status="error",
+                                   reason=f"retries exhausted: {detail}",
+                                   http_status=502, attempts=attempt)
+                span.set_error(f"retries exhausted: {detail}")
+                span.end()
+                return web.json_response(
+                    {"error": {"message": f"upstream error after {attempt} "
+                                          f"attempts: {detail}"}}, status=502)
+            self.metrics.retries.labels(reason=kind).inc()
+            self.flight.record(req.request_id, "retry", attempt=attempt,
+                               endpoint=target.address, reason=detail)
+            delay = self.resilience.backoff_s(attempt)
+            rem = req.remaining_s()
+            if rem is not None:
+                delay = min(delay, max(0.0, rem))
+            if delay > 0:
+                await asyncio.sleep(delay)
+            repick = await self._schedule(req, set(excluded))
+            if repick.endpoint is None:
+                self.flight.finish(req.request_id, event="error",
+                                   status="error",
+                                   reason=f"no alternate endpoint: {detail}",
+                                   http_status=502)
+                span.set_error("no alternate endpoint for retry")
+                span.end()
+                return web.json_response(
+                    {"error": {"message": f"upstream error: {detail} "
+                                          "(no alternate endpoint)"}},
+                    status=502)
+            target = repick.endpoint
+            prefill = repick.prefill_endpoint
+            excluded.add(target.address)
+            attempt += 1
+            span.set_attribute("llm_d.endpoint", target.address)
+            self.flight.record(req.request_id, "routing_decision",
+                               endpoint=target.address, retry_attempt=attempt,
+                               scores=self._profile_scores(repick))
+            self.flight.record(req.request_id, "forward",
+                               endpoint=target.address, attempt=attempt)
 
         echo = {
             "x-llm-d-endpoint": target.address,
             "x-llm-d-request-id": req.request_id,
         }
-        if result.prefill_endpoint is not None:
-            echo[HDR_PREFILLER_HOST_PORT] = result.prefill_endpoint.address
+        if prefill is not None:
+            echo[HDR_PREFILLER_HOST_PORT] = prefill.address
+        if attempt > 1:
+            echo["x-llm-d-attempts"] = str(attempt)
 
         try:
             if resp.headers.get("Content-Type", "").startswith("text/event-stream"):
@@ -593,15 +810,33 @@ class RouterServer:
                 t_last = t_start
                 n_chunks = 0
                 exemplar = {"trace_id": span.context.trace_id}
-                async for chunk in resp.content.iter_any():
-                    t_last = time.monotonic()
-                    if t_first is None:
-                        t_first = t_last
-                        self.metrics.ttft.observe(t_first - t_start,
-                                                  exemplar=exemplar)
-                    n_chunks += 1
-                    await out.write(chunk)
-                await out.write_eof()
+                try:
+                    async for chunk in resp.content.iter_any():
+                        t_last = time.monotonic()
+                        if t_first is None:
+                            t_first = t_last
+                            self.metrics.ttft.observe(t_first - t_start,
+                                                      exemplar=exemplar)
+                        n_chunks += 1
+                        await out.write(chunk)
+                    await out.write_eof()
+                except Exception as e:
+                    # Mid-stream failure: the client already holds part of the
+                    # stream, so a retry would replay tokens — NEVER retried.
+                    # Report the failure (breaker signal) and end the stream.
+                    self.metrics.errors.inc()
+                    self.resilience.on_failure(target.address,
+                                               reason=f"midstream: {e}")
+                    self.scheduler.post_response(req, target,
+                                                 {"error": str(e)})
+                    self.flight.finish(req.request_id, event="error",
+                                       status="error", midstream=True,
+                                       reason=f"midstream: {e}",
+                                       http_status=resp.status,
+                                       chunks=n_chunks)
+                    span.set_error(f"midstream: {e}")
+                    return out
+                self._note_outcome(target.address, resp.status)
                 info: dict[str, Any] = {"status": resp.status}
                 if t_first is not None:
                     info["ttft_ms"] = (t_first - t_start) * 1e3
@@ -623,8 +858,25 @@ class RouterServer:
                         span.set_attribute(f"llm_d.{k}", round(info[k], 3))
                 span.end()
                 return out
-            payload = await resp.read()
+            try:
+                payload = await resp.read()
+            except Exception as e:
+                # body read failed after committed headers: no retry (the
+                # response was already chosen), surface as upstream error
+                self.metrics.errors.inc()
+                self.resilience.on_failure(target.address, reason=f"read: {e}")
+                self.scheduler.post_response(req, target, {"error": str(e)})
+                self.flight.finish(req.request_id, event="error",
+                                   status="error",
+                                   reason=f"upstream read error: {e}",
+                                   http_status=502)
+                span.set_error(f"read: {e}")
+                return web.json_response(
+                    {"error": {"message": f"upstream read error: {e}"}},
+                    status=502)
             e2e_s = time.monotonic() - t_start
+            self._note_outcome(target.address, resp.status)
+            self.resilience.note_latency(e2e_s)
             exemplar = {"trace_id": span.context.trace_id}
             self.metrics.ttft.observe(e2e_s, exemplar=exemplar)
             info = {"status": resp.status, "e2e_ms": e2e_s * 1e3}
@@ -664,7 +916,8 @@ class RouterServer:
         return web.Response(text="\n".join(lines) + "\n")
 
     async def _health(self, request: web.Request):
-        return web.json_response({"status": "ok", "endpoints": len(self.pool)})
+        return web.json_response({"status": "ok", "endpoints": len(self.pool),
+                                  "resilience": self.resilience.snapshot()})
 
     async def _debug_requests(self, request: web.Request):
         from llmd_tpu.obs.events import debug_list_response
@@ -681,14 +934,28 @@ class RouterServer:
         return web.json_response(payload, status=status)
 
     async def _models(self, request: web.Request):
-        # aggregate /v1/models from one healthy endpoint
-        for ep in self.pool.list():
+        """Union of /v1/models across the pool, skipping breaker-open,
+        draining, and stale endpoints and tolerating per-endpoint failures.
+        (Previously the first reachable endpoint answered alone, so a sick
+        first endpoint hid every other endpoint's models.)"""
+        eps = self.pool.list()
+        candidates = [e for e in eps
+                      if self.resilience.healthy(e.address) and not e.stale()]
+        seen: dict[str, dict] = {}
+        for ep in candidates or eps:  # everything filtered: best effort
             try:
                 async with self._session.get(
                     f"http://{ep.address}/v1/models",
                     timeout=aiohttp.ClientTimeout(total=2),
                 ) as r:
-                    return web.json_response(await r.json())
+                    if r.status != 200:
+                        continue
+                    data = await r.json()
             except Exception:
                 continue
-        return web.json_response({"object": "list", "data": []})
+            for m in data.get("data", []) if isinstance(data, dict) else []:
+                mid = m.get("id") if isinstance(m, dict) else None
+                if mid is not None and mid not in seen:
+                    seen[mid] = m
+        return web.json_response({"object": "list",
+                                  "data": list(seen.values())})

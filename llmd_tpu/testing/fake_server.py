@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import random
 import time
 import uuid
 from collections import OrderedDict
@@ -57,6 +58,25 @@ class FakeServerConfig:
     lora_adapters: list[str] = field(default_factory=list)
 
 
+@dataclass
+class FaultConfig:
+    """Programmable fault injection (resilience tests, tools/chaos_check.py).
+
+    Faults target the generation endpoints; /metrics and /health have their
+    own flags. The RNG is seeded so chaos runs replay deterministically."""
+
+    error_rate: float = 0.0  # fraction of generate requests → error_status
+    error_status: int = 503
+    connect_refuse: bool = False  # kill the connection instead of answering
+    latency_s: float = 0.0  # added latency before each generate request
+    midstream_hangup_rate: float = 0.0  # streaming: cut after the first chunk
+    flap_period_s: float = 0.0  # >0: alternate up/down on this period
+    flap_duty: float = 0.5  # fraction of each period the server is UP
+    fail_metrics: bool = False  # /metrics answers 500 (scrape-error paths)
+    fail_health: bool = False  # /health answers 503
+    seed: int = 0
+
+
 class FakeModelServer:
     def __init__(self, cfg: FakeServerConfig, host: str = "127.0.0.1", port: int = 0):
         self.cfg = cfg
@@ -72,6 +92,12 @@ class FakeModelServer:
         self._runner: Optional[web.AppRunner] = None
         self._admit = asyncio.Semaphore(cfg.max_running)
         self.received: list[dict] = []  # request log for assertions
+        # fault injection (resilience/chaos tests): mutate via set_faults()
+        self.faults = FaultConfig()
+        self._fault_rng = random.Random(self.faults.seed)
+        self._flap_t0 = time.monotonic()
+        self.fault_counts = {"errors": 0, "refused": 0, "midstream": 0}
+        self.draining = False  # POST /drain mirrors the engine server
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -83,6 +109,7 @@ class FakeModelServer:
         app.router.add_post("/v1/chat/completions/render", self._render)
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
+        app.router.add_post("/drain", self._drain)
         app.router.add_get("/v1/models", self._models)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -150,6 +177,50 @@ class FakeModelServer:
         self.blocks.clear()
         await self._publish([AllBlocksCleared()])
 
+    # -- fault injection ---------------------------------------------------
+    def set_faults(self, **kw) -> None:
+        """Update fault knobs at runtime (``set_faults(error_rate=0.2)``);
+        passing ``seed`` reseeds the RNG, ``flap_period_s`` restarts the
+        flap schedule from 'up'."""
+        for k, v in kw.items():
+            if not hasattr(self.faults, k):
+                raise AttributeError(f"unknown fault knob {k!r}")
+            setattr(self.faults, k, v)
+        if "seed" in kw:
+            self._fault_rng = random.Random(kw["seed"])
+        if "flap_period_s" in kw:
+            self._flap_t0 = time.monotonic()
+
+    def _flap_down(self) -> bool:
+        f = self.faults
+        if f.flap_period_s <= 0:
+            return False
+        phase = ((time.monotonic() - self._flap_t0) % f.flap_period_s) / f.flap_period_s
+        return phase >= f.flap_duty
+
+    def _refuse(self, request: web.Request):
+        """Kill the connection without an HTTP response: the client sees a
+        reset/disconnect, i.e. a connect-class (retryable) failure."""
+        self.fault_counts["refused"] += 1
+        if request.transport is not None:
+            request.transport.close()
+        raise ConnectionResetError("fault: connection refused")
+
+    async def _maybe_fault(self, request: web.Request) -> Optional[web.Response]:
+        """Evaluate the fault schedule for one generate request. Returns an
+        error response, raises (connect-refuse), or returns None (healthy)."""
+        f = self.faults
+        if f.latency_s > 0:
+            await asyncio.sleep(f.latency_s)
+        if f.connect_refuse:
+            self._refuse(request)
+        if self._flap_down() or (
+                f.error_rate > 0 and self._fault_rng.random() < f.error_rate):
+            self.fault_counts["errors"] += 1
+            return web.json_response({"error": {"message": "fault injected"}},
+                                     status=f.error_status)
+        return None
+
     # -- handlers ----------------------------------------------------------
     async def _serve_generation(self, request: web.Request, prompt: str, body: dict, chat: bool):
         lora = body.get("model") if body.get("model") in self.cfg.lora_adapters else None
@@ -158,6 +229,15 @@ class FakeModelServer:
         stream = bool(body.get("stream", False))
         self.request_count += 1
         self.received.append({"prompt": prompt, "body": body, "t": time.monotonic()})
+        if self.draining:
+            return web.json_response({"error": {"message": "draining"}},
+                                     status=503, headers={"Retry-After": "1"})
+        faulted = await self._maybe_fault(request)
+        if faulted is not None:
+            return faulted
+        # decided up front so one seeded RNG draw covers the whole stream
+        hangup = (stream and self.faults.midstream_hangup_rate > 0
+                  and self._fault_rng.random() < self.faults.midstream_hangup_rate)
 
         self.queued += 1
         async with self._admit:  # FIFO-ish admission, no busy-wait
@@ -182,6 +262,12 @@ class FakeModelServer:
                     await resp.prepare(request)
                     await asyncio.sleep(prefill_s)
                     for i in range(max_tokens):
+                        if hangup and i == 1:
+                            # mid-stream hangup AFTER the first chunk: the
+                            # client holds partial output, so the router must
+                            # NOT retry — exactly the case under test
+                            self.fault_counts["midstream"] += 1
+                            self._refuse(request)
                         await asyncio.sleep(tpot_s)
                         chunk = {
                             "id": rid, "model": model, "created": int(time.time()),
@@ -254,6 +340,8 @@ class FakeModelServer:
         return web.json_response({"prompt_token_ids": fake_tokenize(prompt)})
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        if self.faults.fail_metrics:
+            return web.Response(status=500, text="fault: metrics down")
         util = min(1.0, len(self.blocks) / self.cfg.num_blocks)
         lines = [
             f"vllm:num_requests_waiting {self.queued}",
@@ -270,7 +358,37 @@ class FakeModelServer:
         return web.Response(text="\n".join(lines) + "\n")
 
     async def _health(self, request: web.Request) -> web.Response:
+        if self.faults.fail_health:
+            return web.json_response({"status": "unhealthy"}, status=503)
+        if self.draining:
+            return web.json_response(
+                {"status": "draining", "inflight": self.running}, status=503)
         return web.json_response({"status": "ok"})
+
+    async def _drain(self, request: web.Request) -> web.Response:
+        """Engine-server /drain contract: stop admissions, wait (bounded) for
+        in-flight generations to finish. ``{"enable": false}`` re-opens."""
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:
+            body = {}
+        if body.get("enable") is False:
+            self.draining = False
+            return web.json_response({"status": "ok", "draining": False})
+        self.draining = True
+        try:
+            timeout_s = float(request.query.get("timeout_s", 10.0))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "timeout_s must be a number"}}, status=400)
+        t0 = time.monotonic()
+        while self.running and time.monotonic() - t0 < timeout_s:
+            await asyncio.sleep(0.01)
+        drained = self.running == 0
+        return web.json_response(
+            {"status": "drained" if drained else "timeout",
+             "inflight": self.running},
+            status=200 if drained else 504)
 
     async def _models(self, request: web.Request) -> web.Response:
         data = [{"id": self.cfg.model, "object": "model"}]

@@ -1,0 +1,532 @@
+"""Router resilience layer (router/resilience.py + server.py retry loop):
+deadlines, retries-on-alternate-endpoint, circuit breakers, drain, and the
+fault-injection knobs of the fake server that exercise them.
+
+Unit tests poke ResilienceManager/FlowController directly; the e2e tests run
+the real RouterServer against fault-injected FakeModelServers — the same
+wiring tools/chaos_check.py gates in CI, but with deterministic faults.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.core.request import (
+    HDR_REQUEST_TIMEOUT,
+    InferenceRequest,
+    RequestOutcome,
+)
+from llmd_tpu.router import filters_pickers  # noqa: F401 — register plugins
+from llmd_tpu.router import scorers  # noqa: F401 — register plugins
+from llmd_tpu.router.flowcontrol import FlowController
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.resilience import (
+    RETRYABLE_STATUSES,
+    BreakerState,
+    ResilienceConfig,
+    ResilienceManager,
+)
+from llmd_tpu.router.server import RouterServer
+from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+from tests.conftest import run_async
+
+CFG = """
+plugins:
+  - {name: inflight, type: inflight-load-producer}
+  - {name: queue, type: queue-depth-scorer}
+  - {name: kv-util, type: kv-cache-utilization-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 2}
+      - {pluginRef: kv-util, weight: 1}
+"""
+
+EP = "10.0.0.1:8000"
+EP2 = "10.0.0.2:8000"
+
+
+def _mgr(**kw) -> ResilienceManager:
+    cfg = ResilienceConfig(**kw)
+    return ResilienceManager(cfg)
+
+
+# ---------------------------------------------------------------- unit: knobs
+
+def test_retryable_statuses():
+    m = _mgr()
+    assert RETRYABLE_STATUSES == {502, 503, 504}
+    for s in (502, 503, 504):
+        assert m.retryable_status(s)
+    for s in (200, 400, 404, 429, 500, 501):
+        assert not m.retryable_status(s)
+
+
+def test_backoff_full_jitter_bounds():
+    m = _mgr(retry_backoff_ms=25.0, retry_backoff_max_ms=100.0)
+    for attempt in range(1, 8):
+        cap = min(0.1, 0.025 * (2 ** (attempt - 1)))
+        for _ in range(50):
+            d = m.backoff_s(attempt)
+            assert 0.0 <= d <= cap
+    # the schedule actually spreads (jitter, not a fixed delay)
+    samples = {round(m.backoff_s(3), 6) for _ in range(20)}
+    assert len(samples) > 1
+
+
+def test_deadline_header_parsing():
+    req = InferenceRequest.from_headers({HDR_REQUEST_TIMEOUT: "2.5"},
+                                        request_id="r1", prompt="p")
+    assert req.timeout_s == 2.5
+    rem = req.remaining_s()
+    assert rem is not None and 0 < rem <= 2.5
+    # malformed / non-positive → ignored (router default applies later)
+    for bad in ("abc", "", "-1", "0"):
+        req = InferenceRequest.from_headers({HDR_REQUEST_TIMEOUT: bad},
+                                            request_id="r2", prompt="p")
+        assert req.timeout_s is None
+        assert req.deadline() is None and req.remaining_s() is None
+
+
+# ------------------------------------------------------------- unit: breaker
+
+def test_breaker_consecutive_failures_open_then_half_open_recovery():
+    m = _mgr(breaker_consecutive_failures=3, breaker_cooldown_s=0.05,
+             breaker_half_open_successes=2)
+    assert m.allow(EP)
+    for _ in range(3):
+        m.on_failure(EP, reason="http 503")
+    assert m._breakers[EP].state is BreakerState.OPEN
+    assert not m.allow(EP)
+    assert EP in m.open_endpoints()
+
+    # cooldown elapses → half-open admits exactly one probe
+    now = m._breakers[EP].open_until + 0.001
+    assert m.allow(EP, now=now)
+    assert not m.allow(EP, now=now)
+    m.on_success(EP)
+    assert m._breakers[EP].state is BreakerState.HALF_OPEN  # 1 of 2 successes
+    assert m.allow(EP, now=now)
+    m.on_success(EP)
+    assert m._breakers[EP].state is BreakerState.CLOSED
+    assert m.allow(EP)
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    m = _mgr(breaker_consecutive_failures=2, breaker_cooldown_s=0.05)
+    m.on_failure(EP)
+    m.on_failure(EP)
+    br = m._breakers[EP]
+    assert br.state is BreakerState.OPEN
+    opens_before = br.open_count
+    assert m.allow(EP, now=br.open_until + 0.001)  # the probe
+    m.on_failure(EP, reason="probe failed")
+    assert br.state is BreakerState.OPEN  # straight back, fresh cooldown
+    assert br.open_count == opens_before  # re-open does not re-count/spam
+    assert not m.allow(EP)
+
+
+def test_breaker_failure_rate_opens():
+    m = _mgr(breaker_consecutive_failures=100,  # rate path only
+             breaker_failure_rate=0.5, breaker_window=10, breaker_min_volume=10)
+    # alternate failure/success below min volume: stays closed (a success
+    # before any failure is a no-op — no breaker exists for the address yet)
+    for _ in range(5):
+        m.on_failure(EP)
+        m.on_success(EP)
+    assert m._breakers[EP].state is BreakerState.CLOSED
+    m.on_failure(EP)  # 11th outcome: window full, 50% failures
+    assert m._breakers[EP].state is BreakerState.OPEN
+
+
+def test_half_open_probe_slot_expires():
+    """A consumed probe slot must self-release: filter_endpoints() burns it
+    even when the scheduler picks someone else, and no outcome ever lands."""
+    m = _mgr(breaker_consecutive_failures=1, breaker_cooldown_s=0.05)
+    m.on_failure(EP)
+    t = m._breakers[EP].open_until + 0.001
+    assert m.allow(EP, now=t)  # probe admitted, then... nothing reports back
+    assert not m.allow(EP, now=t + 0.01)
+    assert m.allow(EP, now=t + 0.06)  # slot expired after a cooldown
+
+
+def test_scrape_errors_feed_breaker():
+    m = _mgr(breaker_consecutive_failures=3)
+    for _ in range(3):
+        m.note_scrape_error(EP)
+    assert m._breakers[EP].state is BreakerState.OPEN
+
+
+def test_filter_endpoints_fail_open_and_drain():
+    m = _mgr(breaker_consecutive_failures=1)
+    eps = [Endpoint(address=EP), Endpoint(address=EP2)]
+    assert m.filter_endpoints(eps) == eps
+    m.on_failure(EP)
+    assert [e.address for e in m.filter_endpoints(eps)] == [EP2]
+    m.set_draining(EP2)
+    # everything ejected → fail open with the original set
+    assert m.filter_endpoints(eps) == eps
+    m.set_draining(EP2, False)
+    assert [e.address for e in m.filter_endpoints(eps)] == [EP2]
+
+
+def test_healthy_view_does_not_consume_probe():
+    m = _mgr(breaker_consecutive_failures=1, breaker_cooldown_s=30.0)
+    m.on_failure(EP)
+    assert not m.healthy(EP)  # open, cooldown far away
+    assert m.healthy(EP2)
+    m.set_draining(EP2)
+    assert not m.healthy(EP2)
+    # healthy() on a cooldown-expired breaker must not burn the probe slot
+    m2 = _mgr(breaker_consecutive_failures=1, breaker_cooldown_s=0.0)
+    m2.on_failure(EP)
+    assert m2.healthy(EP)
+    assert m2._breakers[EP].half_open_inflight == 0
+    assert m2.allow(EP)  # the probe is still available
+
+
+# ------------------------------------------------- unit: flow-control deadline
+
+def test_flow_deadline_evicts_while_queued():
+    async def scenario():
+        cfg = FrameworkConfig.from_yaml(
+            CFG + "\nflowControl: {enabled: true}\n",
+            known_types=known_plugin_types())
+        flow = FlowController(cfg.flow_control, EndpointPool())  # empty pool
+        await flow.start()  # ⇒ detector saturated ⇒ dispatch holds
+        try:
+            # budget already spent at enqueue → rejected synchronously
+            spent = InferenceRequest(request_id="r0", prompt="p", timeout_s=0.0)
+            assert (await flow.enqueue_and_wait(spent)
+                    is RequestOutcome.EVICTED_DEADLINE)
+            # budget expires while queued → evicted by the dispatch loop
+            req = InferenceRequest(request_id="r1", prompt="p", timeout_s=0.05)
+            outcome = await asyncio.wait_for(flow.enqueue_and_wait(req), 5)
+            assert outcome is RequestOutcome.EVICTED_DEADLINE
+            assert outcome.http_status == 504
+            assert flow.metrics["evicted_deadline_total"] == 2
+        finally:
+            await flow.stop()
+
+    run_async(scenario())
+
+
+# ------------------------------------------------------------------------ e2e
+
+async def _start_stack(n_servers: int, flow: bool = False, **server_cfg):
+    server_cfg.setdefault("prefill_us_per_token", 10.0)
+    server_cfg.setdefault("decode_us_per_token", 100.0)
+    servers = [FakeModelServer(FakeServerConfig(**server_cfg))
+               for _ in range(n_servers)]
+    for s in servers:
+        await s.start()
+    pool = EndpointPool()
+    for s in servers:
+        pool.upsert(Endpoint(address=s.address))
+    yaml = CFG + ("\nflowControl: {enabled: true}\n" if flow else "")
+    cfg = FrameworkConfig.from_yaml(yaml, known_types=known_plugin_types())
+    router = RouterServer(cfg, pool, port=0, poll_interval_s=0.1)
+    await router.start()
+    await asyncio.sleep(0.25)  # first metrics poll
+    return router, servers
+
+
+async def _stop_stack(router, servers):
+    await router.stop()
+    for s in servers:
+        await s.stop()
+
+
+def _retries_total(router) -> float:
+    return sum(c.value for c in router.metrics.retries._children.values())
+
+
+def test_retry_lands_on_alternate_endpoint():
+    async def scenario():
+        router, servers = await _start_stack(2)
+        bad, good = servers
+        bad.set_faults(error_rate=1.0, error_status=503, seed=7)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                for i in range(8):
+                    async with sess.post(
+                        f"http://{router.address}/v1/completions",
+                        json={"prompt": f"retry {i}", "max_tokens": 2,
+                              "model": "fake/model"},
+                    ) as r:
+                        assert r.status == 200, await r.text()
+                        if int(r.headers.get("x-llm-d-attempts", "1")) > 1:
+                            # retried requests advertise their attempt count
+                            assert r.headers["x-llm-d-attempts"] == "2"
+            # the always-503 endpoint was hit, every hit was retried onto the
+            # healthy endpoint, and nothing leaked to the client
+            assert bad.fault_counts["errors"] >= 1
+            assert good.request_count >= 8
+            assert _retries_total(router) >= bad.fault_counts["errors"]
+            # after enough consecutive 503s its breaker is open
+            snap = router.resilience.snapshot()["breakers"]
+            if bad.fault_counts["errors"] >= 5:
+                assert snap[bad.address]["state"] == "open"
+        finally:
+            await _stop_stack(router, servers)
+
+    run_async(scenario())
+
+
+def test_midstream_failure_is_not_retried():
+    async def scenario():
+        router, servers = await _start_stack(1)
+        servers[0].set_faults(midstream_hangup_rate=1.0, seed=3)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    f"http://{router.address}/v1/completions",
+                    json={"prompt": "stream then die", "max_tokens": 8,
+                          "model": "fake/model", "stream": True},
+                ) as r:
+                    # headers were already streamed before the cut: the status
+                    # is committed, the body just ends early
+                    assert r.status == 200
+                    body = b""
+                    try:
+                        async for chunk in r.content.iter_any():
+                            body += chunk
+                    except aiohttp.ClientError:
+                        pass
+                    assert b"[DONE]" not in body
+            assert servers[0].fault_counts["midstream"] == 1
+            assert servers[0].request_count == 1  # exactly one attempt: NO retry
+            assert _retries_total(router) == 0
+        finally:
+            await _stop_stack(router, servers)
+
+    run_async(scenario())
+
+
+def test_breaker_opens_and_recovers_e2e():
+    async def scenario():
+        router, servers = await _start_stack(2)
+        flaky, steady = servers
+        router.resilience.cfg.breaker_cooldown_s = 0.2
+        flaky.set_faults(error_rate=1.0, error_status=503, seed=5)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async def fire(n):
+                    for i in range(n):
+                        async with sess.post(
+                            f"http://{router.address}/v1/completions",
+                            json={"prompt": f"b {i}", "max_tokens": 2,
+                                  "model": "fake/model"},
+                        ) as r:
+                            assert r.status == 200, await r.text()
+
+                # open: every pick of the flaky endpoint 503s and retries;
+                # 5 consecutive failures trip its breaker
+                while flaky.fault_counts["errors"] < 5:
+                    await fire(4)
+                assert router.resilience.snapshot()[
+                    "breakers"][flaky.address]["state"] == "open"
+                # heal the endpoint, wait out the cooldown, keep traffic
+                # flowing: half-open probes succeed and the breaker closes
+                flaky.set_faults(error_rate=0.0)
+                deadline = asyncio.get_running_loop().time() + 10
+                while (router.resilience._breakers[flaky.address].state
+                       is not BreakerState.CLOSED):
+                    assert asyncio.get_running_loop().time() < deadline, \
+                        "breaker never closed after endpoint recovered"
+                    await fire(2)
+                    await asyncio.sleep(0.05)
+                assert router.resilience.snapshot()["breakers"].get(
+                    flaky.address, {}).get("state", "closed") != "open"
+        finally:
+            await _stop_stack(router, servers)
+
+    run_async(scenario())
+
+
+def test_drain_finishes_inflight_while_router_routes_around():
+    async def scenario():
+        # slow decode so the long request is still in flight when drain lands
+        router, servers = await _start_stack(2, decode_us_per_token=20000.0)
+        try:
+            async with aiohttp.ClientSession() as sess:
+                url = f"http://{router.address}/v1/completions"
+
+                async def long_req():
+                    async with sess.post(url, json={
+                        "prompt": "long running", "max_tokens": 40,
+                        "model": "fake/model",
+                    }) as r:
+                        return r.status
+
+                task = asyncio.ensure_future(long_req())
+                # wait until it is actually running on some endpoint
+                victim = None
+                for _ in range(100):
+                    await asyncio.sleep(0.01)
+                    victim = next((s for s in servers if s.running), None)
+                    if victim:
+                        break
+                assert victim is not None, "long request never started"
+
+                # drain the busy endpoint (the engine-server /drain contract)
+                drain = asyncio.ensure_future(sess.post(
+                    f"http://{victim.address}/drain", params={"timeout_s": "10"}))
+                while not victim.draining:
+                    await asyncio.sleep(0.005)
+                # draining /health answers 503 (readiness flip)
+                async with sess.get(f"http://{victim.address}/health") as h:
+                    assert h.status == 503
+                    assert (await h.json())["status"] == "draining"
+                # new traffic through the router: the draining endpoint 503s,
+                # the retry layer re-schedules — clients never see it
+                for i in range(4):
+                    async with sess.post(url, json={
+                        "prompt": f"during drain {i}", "max_tokens": 2,
+                        "model": "fake/model",
+                    }) as r:
+                        assert r.status == 200, await r.text()
+                # the in-flight request finishes, then the drain call returns
+                assert await task == 200
+                dr = await drain
+                assert dr.status == 200
+                assert (await dr.json())["status"] == "drained"
+                assert victim.running == 0
+                # re-enable and verify the endpoint serves again
+                async with sess.post(f"http://{victim.address}/drain",
+                                     json={"enable": False}) as r:
+                    assert (await r.json())["draining"] is False
+                async with sess.get(f"http://{victim.address}/health") as h:
+                    assert h.status == 200
+        finally:
+            await _stop_stack(router, servers)
+
+    run_async(scenario())
+
+
+def test_deadline_expired_while_queued_is_504_with_flight_event():
+    async def scenario():
+        # flow control enabled + EMPTY pool ⇒ saturation holds dispatch, so
+        # the client budget expires while the request sits in the queue
+        cfg = FrameworkConfig.from_yaml(
+            CFG + "\nflowControl: {enabled: true}\n",
+            known_types=known_plugin_types())
+        router = RouterServer(cfg, EndpointPool(), port=0, poll_interval_s=0.5)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.post(
+                    f"http://{router.address}/v1/completions",
+                    json={"prompt": "too late", "max_tokens": 2,
+                          "model": "fake/model"},
+                    headers={HDR_REQUEST_TIMEOUT: "0.15"},
+                ) as r:
+                    assert r.status == 504, await r.text()
+            assert router.flow.metrics["evicted_deadline_total"] == 1
+            assert router.metrics.flow_evicted_deadline.value == 1
+            # the flight recorder shows WHERE the budget died
+            [summary] = router.flight.snapshot(status="rejected")
+            rec = router.flight.get(summary["request_id"])
+            events = {e["event"] for e in rec["events"]}
+            assert "deadline_exceeded" in events
+        finally:
+            await router.stop()
+
+    run_async(scenario())
+
+
+def test_models_aggregation_unions_pool_and_skips_unhealthy():
+    async def scenario():
+        a = FakeModelServer(FakeServerConfig(model="model-a"))
+        b = FakeModelServer(FakeServerConfig(model="model-b",
+                                             lora_adapters=["lora-b"]))
+        await a.start()
+        await b.start()
+        pool = EndpointPool()
+        pool.upsert(Endpoint(address=a.address))
+        pool.upsert(Endpoint(address=b.address))
+        cfg = FrameworkConfig.from_yaml(CFG, known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0, poll_interval_s=0.1)
+        await router.start()
+        try:
+            async with aiohttp.ClientSession() as sess:
+                url = f"http://{router.address}/v1/models"
+                async with sess.get(url) as r:
+                    ids = {m["id"] for m in (await r.json())["data"]}
+                # the union across the pool — not just the first endpoint
+                assert ids == {"model-a", "model-b", "lora-b"}
+                # a drained/broken endpoint drops out of the aggregation
+                router.resilience.set_draining(a.address)
+                async with sess.get(url) as r:
+                    ids = {m["id"] for m in (await r.json())["data"]}
+                assert ids == {"model-b", "lora-b"}
+        finally:
+            await router.stop()
+            await a.stop()
+            await b.stop()
+
+    run_async(scenario())
+
+
+def test_engine_server_drain_contract():
+    from llmd_tpu.engine.config import EngineConfig
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.models import get_model_config
+
+    async def scenario():
+        server = EngineServer(
+            get_model_config("tiny"),
+            EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                         max_batch_size=4, prefill_chunk=32, decode_steps=2),
+            model_name="test/tiny", host="127.0.0.1", port=0,
+        )
+        await server.start()
+        try:
+            base = f"http://{server.address}"
+            async with aiohttp.ClientSession() as sess:
+                async def gen(tokens):
+                    async with sess.post(f"{base}/v1/completions", json={
+                        "prompt": "drain me please", "max_tokens": tokens,
+                        "temperature": 0.0, "ignore_eos": True,
+                    }) as r:
+                        return r.status
+
+                task = asyncio.ensure_future(gen(48))
+                for _ in range(200):
+                    await asyncio.sleep(0.01)
+                    if server.engine.seqs:
+                        break
+                # drain: admissions stop, in-flight finishes, call returns
+                async with sess.post(f"{base}/drain",
+                                     params={"timeout_s": "30"}) as r:
+                    assert r.status == 200, await r.text()
+                    assert (await r.json())["status"] == "drained"
+                assert await task == 200  # in-flight completed, not killed
+                async with sess.get(f"{base}/health") as h:
+                    assert h.status == 503
+                    assert (await h.json())["status"] == "draining"
+                assert await gen(2) == 503  # admissions closed
+                # deadline header: an already-expired budget is refused
+                async with sess.post(f"{base}/drain",
+                                     json={"enable": False}) as r:
+                    assert r.status == 200
+                async with sess.post(f"{base}/v1/completions", json={
+                    "prompt": "late", "max_tokens": 2,
+                }, headers={HDR_REQUEST_TIMEOUT: "0"}) as r:
+                    assert r.status == 504
+                assert await gen(2) == 200  # back in service
+            events = [e["event"]
+                      for e in server.engine.flight.system_events()]
+            assert "drain_start" in events and "drain_done" in events
+        finally:
+            await server.stop()
+
+    run_async(scenario())
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(pytest.main([__file__, "-v"]))

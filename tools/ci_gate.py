@@ -56,6 +56,7 @@ def main() -> int:
         ("lint-metrics", [py, "tools/lint_metrics.py"], CPU_ENV),
         ("lint-events", [py, "tools/lint_events.py"], CPU_ENV),
         ("validate-manifests", [py, "tools/validate_manifests.py", "deploy"], None),
+        ("chaos-check", [py, "tools/chaos_check.py"], CPU_ENV),
     ]
     if not args.skip_tests:
         pytest_cmd = [py, "-m", "pytest", "tests/", "-q"]
