@@ -142,6 +142,20 @@ def main() -> None:
                          "f=Dhp/head_dim real KV heads per 128-lane row on "
                          "eligible models (llama-1b: f=2, halves KV bytes "
                          "again); padded forces one head per row (A/B)")
+    ap.add_argument("--spec-mode", default="off", choices=["off", "ngram"],
+                    help="speculative decoding: ngram = prompt-lookup drafts "
+                         "verified through the mixed-batch step (one verify "
+                         "step can land several output tokens; greedy "
+                         "acceptance keeps output bitwise identical)")
+    ap.add_argument("--spec-tokens", type=int, default=None,
+                    help="max draft tokens per sequence per verify step "
+                         "(default: EngineConfig default)")
+    ap.add_argument("--workload", default="uniform", choices=["uniform", "echo"],
+                    help="prompt distribution: uniform = distinct pseudo-random "
+                         "streams (no lookup structure); echo = periodic "
+                         "prompts whose continuations repeat — the shared-"
+                         "prefix/agentic/summarization regime where prompt-"
+                         "lookup acceptance is high")
     args = ap.parse_args()
     tiny = args.tiny
     if args.cpu:
@@ -176,7 +190,8 @@ def main() -> None:
                              args.layer_unroll]) \
                 and os.environ.get("LLMD_LAYER_UNROLL") in (None, "", "1") \
                 and args.quantize == "default" and args.kv_dtype == "default" \
-                and args.kv_layout == "auto"
+                and args.kv_layout == "auto" and args.spec_mode == "off" \
+                and args.spec_tokens is None and args.workload == "uniform"
             if flag_default:
                 try:
                     import glob as _glob
@@ -262,6 +277,11 @@ def main() -> None:
     kv_explicit = args.kv_dtype != "default" or args.kv_layout != "auto"
     eng_cfg.kv_cache_dtype = "fp8" if args.kv_dtype == "fp8" else None
     eng_cfg.kv_layout = args.kv_layout
+    spec_explicit = (args.spec_mode != "off" or args.spec_tokens is not None
+                     or args.workload != "uniform")
+    eng_cfg.spec_mode = args.spec_mode
+    if args.spec_tokens is not None:
+        eng_cfg.spec_tokens = args.spec_tokens
     # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
     # the latency the pipelined decode path exists to hide
     import jax.numpy as jnp
@@ -289,6 +309,14 @@ def main() -> None:
     sp = SamplingParams(max_tokens=osl, temperature=0.0, ignore_eos=True)
 
     def prompts(n: int, salt: int):
+        if args.workload == "echo":
+            # echo-heavy: each prompt is a short per-request pattern repeated
+            # to ISL (still distinct across requests — no prefix-cache
+            # shortcut), so the continuation repeats spans of the context —
+            # the regime where prompt-lookup drafting pays
+            period = 3
+            return [[(salt * 7919 + i * 131 + j % period) % (cfg.vocab_size - 2) + 1
+                     for j in range(isl)] for i in range(n)]
         # distinct prompts (no prefix-cache shortcut): salt offsets the token stream
         return [[(salt * 7919 + i * 131 + j) % (cfg.vocab_size - 2) + 1 for j in range(isl)]
                 for i in range(n)]
@@ -422,7 +450,8 @@ def main() -> None:
         # a bench run must never die to a config experiment — fall back to the
         # r03-proven shape and measure that instead
         if (tiny or args.batch or args.decode_steps or args.isl or args.osl
-                or args.layer_unroll or quantize_explicit or kv_explicit):
+                or args.layer_unroll or quantize_explicit or kv_explicit
+                or spec_explicit):
             # an explicitly requested shape or quantization must not silently
             # re-measure as something else (e.g. bf16 under an "int8" label)
             raise
@@ -473,7 +502,8 @@ def main() -> None:
     decode_bw_gbs = decode_tput * hbm_gb_per_tok
     flops_per_tok = 2 * n_params
     mfu = tput * flops_per_tok / (peak_tflops * 1e12)
-    launch_gap = wall - st.time_prefill_steps - st.time_decode_steps
+    launch_gap = (wall - st.time_prefill_steps - st.time_decode_steps
+                  - st.time_spec_steps)
     dev_ms_per_decode = (st.time_device_decode / max(1, st.n_decode_calls)) * 1e3
     pack_us_per_call = (
         st.time_host_pack / max(1, st.n_decode_calls + st.n_unified_steps)) * 1e6
@@ -482,8 +512,14 @@ def main() -> None:
           f"(prefill {st.total_prefill_tokens} toks, "
           f"decode {st.total_decode_tokens} toks, "
           f"preemptions {st.total_preemptions})", file=sys.stderr)
+    if st.n_spec_verify_steps:
+        print(f"# spec: drafted {st.spec_drafted}, accepted {st.spec_accepted}, "
+              f"rejected {st.spec_rejected} over {st.n_spec_verify_steps} verify "
+              f"steps ({st.spec_accepted / st.n_spec_verify_steps:.2f} "
+              f"accepted/verify-step)", file=sys.stderr)
     print(f"# phase split: prefill-steps {st.time_prefill_steps:.2f}s, "
-          f"decode-steps {st.time_decode_steps:.2f}s, launch-gap {launch_gap:.2f}s | "
+          f"decode-steps {st.time_decode_steps:.2f}s, "
+          f"spec-steps {st.time_spec_steps:.2f}s, launch-gap {launch_gap:.2f}s | "
           f"host-pack {st.time_host_pack:.2f}s, device {st.time_device:.2f}s, "
           f"post {st.time_postprocess:.2f}s "
           f"({st.n_unified_steps} unified + {st.n_decode_calls} decode calls; "
@@ -520,6 +556,7 @@ def main() -> None:
         "wall_s": round(wall, 3),
         "prefill_steps_s": round(st.time_prefill_steps, 3),
         "decode_steps_s": round(st.time_decode_steps, 3),
+        "spec_steps_s": round(st.time_spec_steps, 3),
         "launch_gap_s": round(launch_gap, 3),
         "host_pack_s": round(st.time_host_pack, 3),
         "device_s": round(st.time_device, 3),
@@ -536,6 +573,16 @@ def main() -> None:
         "decode_steps_fused": eng_cfg.decode_steps,
         "isl": isl,
         "osl": osl,
+        "workload": args.workload,
+        "spec_mode": eng_cfg.spec_mode,
+        "spec_tokens": eng_cfg.spec_tokens if eng_cfg.spec_mode != "off" else None,
+        "spec_drafted": st.spec_drafted,
+        "spec_accepted": st.spec_accepted,
+        "spec_rejected": st.spec_rejected,
+        "spec_verify_steps": st.n_spec_verify_steps,
+        "spec_accepted_per_verify_step": round(
+            st.spec_accepted / st.n_spec_verify_steps, 3)
+        if st.n_spec_verify_steps else None,
     }))
 
 

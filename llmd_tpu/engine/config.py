@@ -113,6 +113,16 @@ class EngineConfig:
     # LoRA multi-adapter serving (model-servers.md:55-75); None = disabled.
     # Imported lazily to avoid a models<->engine import cycle at module load.
     lora: "object | None" = None  # llmd_tpu.models.lora.LoRAConfig
+    # Speculative decoding (engine/spec.py): "off" = plain decode, "ngram" =
+    # prompt-lookup drafting verified through the flat mixed-batch program.
+    # Greedy acceptance keeps output bitwise identical to spec_mode="off";
+    # sequences sampling at temperature > 0 fall back to plain decode.
+    spec_mode: str = "off"
+    # Max draft tokens proposed (and verified) per sequence per verify step.
+    spec_tokens: int = 4
+    # Suffix n-gram match lengths tried by the drafter, longest first.
+    spec_ngram_max: int = 3
+    spec_ngram_min: int = 1
 
     @property
     def max_pages_per_seq(self) -> int:

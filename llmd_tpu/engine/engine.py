@@ -36,7 +36,8 @@ from llmd_tpu.core.kv_events import KVEvent
 from llmd_tpu.core.request import SamplingParams
 from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.kv_manager import PageAllocator, Sequence
-from llmd_tpu.engine.sampling import sample_tokens
+from llmd_tpu.engine.sampling import greedy_tokens, sample_tokens
+from llmd_tpu.engine.spec import propose_ngram_draft
 from llmd_tpu.models.config import ModelConfig
 from llmd_tpu.obs.events import FlightRecorder
 from llmd_tpu.obs.metrics import Registry, register_engine_metrics
@@ -86,6 +87,7 @@ class EngineStats:
     # number must be decomposable into where the time actually went):
     time_prefill_steps: float = 0.0  # wall inside unified (mixed/prefill) steps
     time_decode_steps: float = 0.0  # wall inside fused decode calls
+    time_spec_steps: float = 0.0  # wall inside speculative verify steps
     time_host_pack: float = 0.0  # host-side batch packing (numpy staging)
     time_device: float = 0.0  # jitted call + device sync (incl. dispatch)
     time_device_decode: float = 0.0  # the decode-call share of time_device
@@ -95,6 +97,12 @@ class EngineStats:
     n_decode_dispatches: int = 0  # fused decode calls LAUNCHED; must equal
     # n_decode_calls once the engine drains — a gap means an in-flight record
     # was orphaned (its sampled tokens silently dropped)
+    # Speculative decoding (spec_mode="ngram"): prompt-lookup drafts verified
+    # through the flat mixed-batch program (engine/spec.py).
+    spec_drafted: int = 0
+    spec_accepted: int = 0
+    spec_rejected: int = 0
+    n_spec_verify_steps: int = 0
 
 
 class LLMEngine:
@@ -228,6 +236,12 @@ class LLMEngine:
         if engine_cfg.kv_layout not in ("auto", "padded", "packed"):
             raise ValueError(f"unknown kv_layout={engine_cfg.kv_layout!r} "
                              "(supported: 'auto', 'padded', 'packed')")
+        if engine_cfg.spec_mode not in ("off", "ngram"):
+            raise ValueError(f"unknown spec_mode={engine_cfg.spec_mode!r} "
+                             "(supported: 'off', 'ngram')")
+        # cumulative prefix-cache effectiveness (feeds the hit-ratio gauge)
+        self._prefix_cached_total = 0
+        self._prefix_prompt_total = 0
         self.kv_pack = (pack_factor(model_cfg)
                         if engine_cfg.kv_layout in ("auto", "packed") else 1)
         if engine_cfg.kv_layout == "packed" and self.kv_pack == 1:
@@ -340,6 +354,30 @@ class LLMEngine:
 
             return _unified
 
+        def _make_verify(attn_fn):
+            def _verify(params, cache, tokens, positions, seq_slots, page_tables,
+                        kv_lens, cu_q_lens, num_seqs, lora_tok):
+                """Speculative verify: the same flat mixed-batch packing as
+                ``_unified``, extended to return the greedy token at EVERY
+                packed position instead of only each sequence's last row —
+                prompt-lookup drafts are checked against the continuation of
+                every chunk position. The [NT, vocab] logits never leave the
+                device; the host reads only [NT] int32 argmax tokens."""
+                tokens = _bind(tokens, ("dp", "sp"))
+                positions = _bind(positions, ("dp", "sp"))
+                seq_slots = _bind(seq_slots, ("dp", "sp"))
+                hidden, cache, cnt = forward_core(
+                    cfg, params, cache, tokens, positions, seq_slots, page_tables,
+                    kv_lens, cu_q_lens=cu_q_lens, num_seqs=num_seqs,
+                    attn_impl=attn_fn, moe_matmul_impl=moe_impl,
+                    lora_indices=lora_tok if use_lora else None,
+                    lora_scale=lora_scale,
+                )
+                greedy = greedy_tokens(unembed(cfg, params, hidden))  # [NT]
+                return greedy, cache, cnt
+
+            return _verify
+
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
                           temp, top_k, top_p, key, steps_left, lora_idx):
             """k decode iterations fused on-device (lax.scan): feed sampled token back
@@ -402,6 +440,9 @@ class LLMEngine:
 
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
         self._unified_fn = jax.jit(_make_unified(attn), **donate)
+        # jit is lazy: the verify program only compiles on the first verify
+        # step, so spec_mode="off" engines never pay for it
+        self._verify_fn = jax.jit(_make_verify(attn), **donate)
         self._decode_multi_fn = jax.jit(_decode_multi, **donate)
         self._embed_fn = jax.jit(_embed, **donate)
         # SP long-context prefill: a second unified program whose attention is
@@ -920,6 +961,15 @@ class LLMEngine:
             seq.block_hashes = keys[: n_hbm + len(off_pages) + len(conn_pages)]
             seq.num_computed = (n_hbm + len(off_pages) + len(conn_pages)) * ps
             seq.num_cached_prompt = seq.num_computed
+            # prefix-cache effectiveness: the hit data always existed here but
+            # never reached /metrics (cached tokens / prompt tokens, plus a
+            # cumulative hit-ratio gauge)
+            self._prefix_cached_total += seq.num_cached_prompt
+            self._prefix_prompt_total += seq.prompt_len
+            self.metrics.prefix_cached_tokens.inc(seq.num_cached_prompt)
+            self.metrics.prefix_prompt_tokens.inc(seq.prompt_len)
+            self.metrics.prefix_hit_ratio.set(
+                self._prefix_cached_total / max(1, self._prefix_prompt_total))
             if seq.admit_features is not None:
                 seq.admit_features["prefix_match_pct"] = (
                     seq.num_cached_prompt / max(1, seq.prompt_len))
@@ -1069,7 +1119,10 @@ class LLMEngine:
             # decode builds its batch from host token state: the deferred
             # prefill sample (first tokens) must land first
             self._flush_pending_sample()
-            self._step_decode()
+            # speculation gate: a verify step replaces this step's fused
+            # decode call when prompt-lookup drafts exist (spec_mode="ngram")
+            if not (self.cfg.spec_mode == "ngram" and self._spec_try_verify()):
+                self._step_decode()
         self.stats.num_waiting = sum(len(q) for q in self.waitq)
         self.stats.num_running = sum(1 for s in self.running if s is not None)
         self.stats.kv_utilization = (
@@ -1418,6 +1471,211 @@ class LLMEngine:
         for rec in q:
             self._decode_process(rec)
 
+    # ------------------------------------------------------------ speculation
+    def _spec_propose(self, s: Sequence, max_draft: int) -> list[int]:
+        """Prompt-lookup draft for one decode-ready seq, clamped so the
+        verify step can land every accepted token: k drafts + 1 bonus token
+        may append, so k is bounded by the remaining max_tokens /
+        max_model_len budget minus one (the bonus token is the plain-decode
+        token and is always in budget)."""
+        k = min(self.cfg.spec_tokens, max_draft,
+                s.max_tokens - s.num_generated - 1,
+                self.cfg.max_model_len - len(s.token_ids) - 1)
+        if k <= 0:
+            return []
+        draft = propose_ngram_draft(s.token_ids, k, self.cfg.spec_ngram_max,
+                                    self.cfg.spec_ngram_min)
+        return draft[:k]
+
+    def _spec_try_verify(self) -> bool:
+        """Decode-path speculation gate; True = a verify step ran (replacing
+        this step's fused decode call).
+
+        Probes the drafter on the current host view first: while pipelined
+        fused calls are in flight that view is stale, but a stale no-match is
+        a cheap signal to keep the pipelined decode path (non-echo workloads
+        keep their dispatch chain). Only a positive probe pays the flush;
+        drafts are then re-proposed on the landed state. After the flush the
+        decode horizon is read from live ``len(token_ids)``, so the next
+        fused call's clamp accounts for accepted-token jumps automatically.
+        """
+        active = self._decode_ready()
+        if not active:
+            return False
+        # Greedy acceptance is only bitwise-equivalent to sequential decoding
+        # for greedy rows; a batch with sampled sequences falls back to the
+        # fused decode path.
+        if any(s.sampling.temperature > 0.0 for s in active):
+            return False
+        if not any(self._spec_propose(s, self.cfg.spec_tokens) for s in active):
+            return False
+        self._flush_pending_decode()
+        active = [s for s in self._decode_ready() if s.slot >= 0]
+        if not active:
+            return True  # the flush retired/changed the batch; step done
+        NT = self.cfg.batched_tokens
+        R = self.num_ranks
+        # every active row is guaranteed its plain token (batched_tokens >=
+        # max_batch_size); drafts share the leftover per-rank budget
+        spare = [NT // R] * R
+        for s in active:
+            spare[s.rank] -= 1
+        plan: list[tuple[Sequence, list[int]]] = []
+        for s in active:
+            if len(plan) >= self.cfg.max_batch_size:
+                break
+            if s.slot < 0:
+                continue  # preempted while packing an earlier row
+            draft = self._spec_propose(s, max(0, spare[s.rank]))
+            if draft and not self._ensure_pages(s, len(s.token_ids) + len(draft)):
+                draft = []  # shed the draft before shedding a sequence
+            if not self._ensure_pages(s, len(s.token_ids)):
+                if not self._preempt_one(s.rank, exclude=s) or s.slot < 0:
+                    self._finish_if_outgrew_pool(s)
+                    continue
+                if not self._ensure_pages(s, len(s.token_ids)):
+                    continue
+            plan.append((s, draft))
+            spare[s.rank] -= len(draft)
+        plan = [(s, d) for s, d in plan if s.slot >= 0]
+        if not any(d for _, d in plan):
+            return False  # fresh state proposes nothing: plain decode instead
+        self._step_spec_verify(plan)
+        return True
+
+    def _step_spec_verify(self, plan: list[tuple[Sequence, list[int]]]) -> None:
+        """Pack each sequence's draft as a short self-contained chunk (its
+        last real token + the draft) through the verify program, accept the
+        longest greedy-matching prefix plus one bonus token, and roll back
+        the rejected tail — host token state never contains a draft token
+        unless verification proved it, so ``maybe_commit_blocks`` can never
+        commit an unverified page, and surplus draft pages release straight
+        back to the allocator's free list."""
+        t0 = time.perf_counter()
+        t0_ns = time.time_ns()
+        NT = self.cfg.batched_tokens
+        B = self.cfg.max_batch_size
+        toks = np.zeros((NT,), np.int32)
+        pos = np.full((NT,), -1, np.int32)
+        sids = np.zeros((NT,), np.int32)
+        lora_tok = np.zeros((NT,), np.int32)
+        pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
+        lens = np.ones((B,), np.int32)
+        cu = np.zeros((B + 1,), np.int32)
+        off = 0
+        rows: list[tuple[Sequence, list[int], int, int]] = []
+        for i, (s, draft) in enumerate(plan):
+            start = len(s.token_ids) - 1
+            chunk = [s.token_ids[-1]] + draft
+            n = len(chunk)
+            toks[off : off + n] = chunk
+            pos[off : off + n] = np.arange(start, start + n)
+            sids[off : off + n] = i
+            lora_tok[off : off + n] = self._lora_slot(s)
+            pts[i, : len(s.pages)] = s.pages
+            lens[i] = start + n
+            if draft:
+                s.spec_drafted += len(draft)
+                self.stats.spec_drafted += len(draft)
+                self.metrics.spec_drafted.inc(len(draft))
+                self.flight.record(s.request_id, "spec_draft",
+                                   drafted=len(draft))
+            rows.append((s, draft, off, s.slot))
+            off += n
+            cu[i + 1] = off
+        cu[len(plan) + 1 :] = off
+        t1 = time.perf_counter()
+        greedy, self.cache, cnt = self._verify_fn(
+            self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens),
+            jnp.asarray(cu), jnp.asarray([len(plan)], jnp.int32),
+            jnp.asarray(lora_tok),
+        )
+        g = np.asarray(greedy)  # [NT] (device sync point)
+        t2 = time.perf_counter()
+        if self._eplb is not None:
+            self._eplb_record(cnt)
+        now = time.monotonic()
+        n_tokens = 0
+        for s, draft, row0, slot in rows:
+            if s.finished or s.slot != slot or self.running[slot] is not s:
+                continue  # preempted while packing later rows
+            kept: list[int] = []
+            finished, reason = False, None
+            # Row j's greedy token continues chunk position start+j: accept
+            # drafts while they match it, append the first divergence (the
+            # bonus token — exactly what sequential decode would emit).
+            for j in range(len(draft) + 1):
+                t = int(g[row0 + j])
+                kept.append(t)
+                s.token_ids.append(t)
+                finished, reason = self._check_finish(s, t)
+                if finished or j >= len(draft) or draft[j] != t:
+                    break
+            accepted = sum(1 for j, t in enumerate(kept)
+                           if j < len(draft) and draft[j] == t)
+            rejected = len(draft) - accepted
+            # the newest token's KV is never written yet → computed = len - 1
+            s.num_computed = len(s.token_ids) - 1
+            if s.first_token_time is None:
+                s.first_token_time = now
+                self.flight.record(
+                    s.request_id, "first_token",
+                    ttft_ms=round((now - s.arrival_time) * 1e3, 3))
+            s.maybe_commit_blocks(self.allocs[s.rank])
+            self._spec_release_tail(s)
+            s.spec_accepted += accepted
+            st = self.stats
+            st.spec_accepted += accepted
+            st.spec_rejected += rejected
+            st.total_decode_tokens += len(kept)
+            n_tokens += len(kept)
+            if accepted:
+                self.metrics.spec_accepted.inc(accepted)
+            if rejected:
+                self.metrics.spec_rejected.inc(rejected)
+            if draft:
+                self.flight.record(s.request_id, "spec_verify",
+                                   drafted=len(draft), accepted=accepted,
+                                   n_tokens=len(kept),
+                                   generated=s.num_generated)
+            else:
+                self.flight.record(s.request_id, "decode", n_tokens=len(kept),
+                                   generated=s.num_generated)
+            if finished:
+                self._retire(s, reason)
+            self._outputs.append(EngineOutput(
+                request_id=s.request_id, new_token_ids=kept, finished=finished,
+                finish_reason=reason,
+                num_cached_prompt_tokens=s.num_cached_prompt,
+                prompt_len=s.prompt_len,
+            ))
+        t3 = time.perf_counter()
+        st = self.stats
+        st.time_host_pack += t1 - t0
+        st.time_device += t2 - t1
+        st.time_postprocess += t3 - t2
+        st.time_spec_steps += t3 - t0
+        st.n_spec_verify_steps += 1
+        if n_tokens:
+            self.metrics.decode_tokens.inc(n_tokens)
+        self.metrics.step_duration.labels(phase="spec_verify").observe(
+            t3 - t0, exemplar=self._trace_exemplar([s for s, _, _, _ in rows]))
+        self._emit_step_spans("spec_verify", [s for s, _, _, _ in rows], t0_ns,
+                              len(plan), n_tokens)
+
+    def _spec_release_tail(self, s: Sequence) -> None:
+        """Roll back KV pages grown for rejected draft tokens: trim the page
+        ledger to what the accepted length needs. Trimmed pages carry refs=1
+        and no block hash (commits never cover unverified tokens), so
+        ``release`` returns them straight to the free list — the r05
+        page-ledger consistency invariant holds through every rollback."""
+        ps = self.cfg.page_size
+        need = max((len(s.token_ids) + ps - 1) // ps, len(s.block_hashes))
+        alloc = self.allocs[s.rank]
+        while len(s.pages) > need:
+            alloc.release(s.pages.pop())
+
     def _decode_dispatch(self, active: list[Sequence], k: int, chain: Optional[dict],
                          wall_start: float, off: int = 0) -> dict:
         """Pack host state (+ the un-processed offset across ALL in-flight calls)
@@ -1536,6 +1794,9 @@ class LLMEngine:
         """Shared retirement path: free slot + pages, drop from the live map."""
         seq.finished = True
         seq.finish_reason = reason
+        if seq.spec_drafted > 0:
+            self.metrics.spec_acceptance.observe(
+                seq.spec_accepted / seq.spec_drafted)
         self.flight.finish(
             seq.request_id, event="retired", reason=reason or "",
             generated=seq.num_generated,

@@ -249,6 +249,10 @@ class Sequence:
     # obs.tracing.SpanContext of the request span (engine.generate) when the
     # request arrived traced — engine step spans parent onto it
     trace_ctx: Optional[object] = None
+    # Speculative decoding tallies (engine/spec.py): drafted/accepted feed the
+    # per-request acceptance-rate summary observed at retirement.
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def num_generated(self) -> int:

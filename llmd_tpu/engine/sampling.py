@@ -12,6 +12,15 @@ import jax
 import jax.numpy as jnp
 
 
+def greedy_tokens(logits: jax.Array) -> jax.Array:
+    """Greedy token per row, matching `sample_tokens`' temperature<=0 branch
+    bitwise: argmax over float32 logits. The speculative verify program
+    (engine._make_verify) uses this on every packed position, so accepted
+    draft tokens are exactly what sequential greedy decoding would emit.
+    """
+    return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("top_k_max",))
 def sample_tokens(
     logits: jax.Array,  # [B, V] float32

@@ -56,6 +56,19 @@ def main() -> None:
                     help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
     ap.add_argument("--offload-fs-path", default=None,
                     help="FS tier below the CPU tier (llmd_fs_backend path)")
+    ap.add_argument("--spec-mode", default=os.environ.get("LLMD_SPEC_MODE", "off"),
+                    choices=["off", "ngram"],
+                    help="speculative decoding: 'ngram' = prompt-lookup drafts "
+                         "verified through the mixed-batch step (engine/spec.py)")
+    ap.add_argument("--spec-tokens", type=int,
+                    default=int(os.environ.get("LLMD_SPEC_TOKENS", "4")),
+                    help="max draft tokens proposed per sequence per verify step")
+    ap.add_argument("--spec-ngram-max", type=int,
+                    default=int(os.environ.get("LLMD_SPEC_NGRAM_MAX", "3")),
+                    help="longest suffix n-gram the drafter matches")
+    ap.add_argument("--spec-ngram-min", type=int,
+                    default=int(os.environ.get("LLMD_SPEC_NGRAM_MIN", "1")),
+                    help="shortest suffix n-gram the drafter falls back to")
     ap.add_argument("--enable-lora", action="store_true",
                     help="enable dynamic LoRA adapter serving")
     ap.add_argument("--max-loras", type=int, default=8)
@@ -100,6 +113,8 @@ def main() -> None:
         quantize_weights=args.quantize,
         kv_cache_dtype=args.kv_cache_dtype,
         kv_layout=args.kv_layout,
+        spec_mode=args.spec_mode, spec_tokens=args.spec_tokens,
+        spec_ngram_max=args.spec_ngram_max, spec_ngram_min=args.spec_ngram_min,
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig

@@ -64,6 +64,8 @@ EVENT_CATALOG = (
     "prefill_end",
     "first_token",
     "decode",
+    "spec_draft",
+    "spec_verify",
     "preempted",
     "kv_reload",
     "kv_offload",

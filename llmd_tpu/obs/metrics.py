@@ -430,7 +430,7 @@ class EngineMetrics:
         self.step_duration = reg.histogram(
             "llmd_tpu:engine_step_duration_seconds",
             "Engine step wall time by phase "
-            "(unified, decode_dispatch, decode_process)",
+            "(unified, decode_dispatch, decode_process, spec_verify)",
             labelnames=("phase",))
         self.batch_occupancy = reg.histogram(
             "llmd_tpu:engine_batch_occupancy",
@@ -497,6 +497,32 @@ class EngineMetrics:
         self.offload_cpu_blocks = reg.gauge(
             "llmd_tpu:offload_cpu_blocks",
             "Blocks currently resident in the CPU offload store")
+        # Prefix-cache effectiveness: fed at admission from
+        # seq.num_cached_prompt (engine._try_admit_rank) — the hit data always
+        # existed host-side but never reached /metrics.
+        self.prefix_cached_tokens = reg.counter(
+            "llmd_tpu:engine_prefix_cached_tokens_total",
+            "Prompt tokens served from the prefix cache at admission")
+        self.prefix_prompt_tokens = reg.counter(
+            "llmd_tpu:engine_prefix_prompt_tokens_total",
+            "Prompt tokens of admitted sequences (prefix hit-ratio denominator)")
+        self.prefix_hit_ratio = reg.gauge(
+            "llmd_tpu:engine_prefix_cache_hit_ratio",
+            "Cumulative prefix-cache hit ratio (cached / prompt tokens)")
+        # Speculative decoding (engine/spec.py prompt-lookup drafts verified
+        # through the flat mixed-batch program).
+        self.spec_drafted = reg.counter(
+            "llmd_tpu:spec_drafted_tokens_total",
+            "Draft tokens proposed by the prompt-lookup drafter")
+        self.spec_accepted = reg.counter(
+            "llmd_tpu:spec_accepted_tokens_total",
+            "Draft tokens accepted by greedy verification")
+        self.spec_rejected = reg.counter(
+            "llmd_tpu:spec_rejected_tokens_total",
+            "Draft tokens rejected (rolled back) by greedy verification")
+        self.spec_acceptance = reg.summary(
+            "llmd_tpu:spec_acceptance_rate",
+            "Per-request draft acceptance rate, observed at retirement")
 
 
 class EngineServerMetrics:

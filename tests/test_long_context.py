@@ -23,14 +23,16 @@ def _prompt(n, seed=0):
 
 
 def test_multi_thousand_token_prefill_decodes():
-    """A 1.5k-token prompt over 6 unified chunks and ~100 pages; generation
-    continues past the prompt. (Shapes sized to CPU compile budgets — the
-    8k+ shapes compile the same programs, just bigger.)"""
+    """A 1.5k-token prompt over multiple unified chunks and ~100 pages;
+    generation continues past the prompt. (Shapes sized to CPU wall budgets —
+    the 8k+ shapes compile the same programs, just bigger. Each unified step
+    pays a near-fixed cost on CPU regardless of chunk fill, so chunk=512
+    covers the same 1536 tokens in 3 steps instead of 6 at half the wall.)"""
     eng = LLMEngine(CFG, EngineConfig(page_size=16, num_pages=128,
                                       max_model_len=2048, max_batch_size=2,
-                                      prefill_chunk=256,
+                                      prefill_chunk=512,
                                       max_num_batched_tokens=512,
-                                      decode_steps=8))
+                                      decode_steps=4))
     prompt = _prompt(1536)
     out = {}
     eng.add_request("long", prompt, SamplingParams(max_tokens=16, temperature=0.0,
@@ -47,9 +49,9 @@ def test_multi_thousand_token_prefill_decodes():
     # deterministic across runs (no state corruption at depth)
     eng2 = LLMEngine(CFG, EngineConfig(page_size=16, num_pages=128,
                                        max_model_len=2048, max_batch_size=2,
-                                       prefill_chunk=256,
+                                       prefill_chunk=512,
                                        max_num_batched_tokens=512,
-                                       decode_steps=8))
+                                       decode_steps=4))
     eng2.add_request("long", list(prompt), SamplingParams(max_tokens=16,
                                                           temperature=0.0,
                                                           ignore_eos=True))
@@ -66,7 +68,7 @@ def test_long_prefix_survives_offload_roundtrip():
     offload tier instead of recomputing everything."""
     eng = LLMEngine(CFG, EngineConfig(page_size=16, num_pages=96,
                                       max_model_len=2048, max_batch_size=2,
-                                      prefill_chunk=256,
+                                      prefill_chunk=512,
                                       max_num_batched_tokens=512,
                                       cpu_offload_pages=256,
                                       offload_watermark_pages=64,

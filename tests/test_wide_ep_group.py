@@ -24,6 +24,19 @@ from llmd_tpu.models import get_model_config
 from llmd_tpu.parallel.mesh import MeshConfig
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _reap_dp_rank_workers():
+    """Tier-1 hygiene: reap dp_rank_worker.py subprocesses that outlive their
+    test — a worker's own children survive the killpg when the session leader
+    was already dead, and a timed-out test skips its finally entirely. Leaked
+    workers keep compiling/serving in the background and pollute the timing of
+    every later module. pkill exiting 1 (nothing matched) is the happy path."""
+    yield
+    import subprocess
+
+    subprocess.run(["pkill", "-f", "dp_rank_worker.py"], check=False)
+
+
 def _moe_cfg():
     from dataclasses import replace
 

@@ -66,6 +66,11 @@ def main() -> int:
     if not args.skip_bench:
         stages.append(("bench-tiny-cpu",
                        [py, "bench.py", "--tiny", "--cpu"], None))
+        # spec_mode=ngram smoke: the speculative verify path (drafting,
+        # mixed-batch verify, rollback) must survive a full tiny serve on CPU
+        stages.append(("bench-tiny-spec",
+                       [py, "bench.py", "--tiny", "--cpu",
+                        "--spec-mode", "ngram", "--workload", "echo"], None))
     if not args.skip_dryrun:
         n = 2 if args.quick else 8
         stages.append((f"dryrun-multichip-{n}",
