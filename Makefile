@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -29,6 +29,10 @@ bench:
 # router resilience vs fault-injected endpoints (goodput >= 99%, no 5xx)
 chaos:
 	JAX_PLATFORMS=cpu $(PY) tools/chaos_check.py
+
+# grammar-constrained decoding: 100% conformance, malformed schemas -> 400
+structured:
+	JAX_PLATFORMS=cpu $(PY) tools/structured_check.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -150,12 +150,15 @@ def main() -> None:
     ap.add_argument("--spec-tokens", type=int, default=None,
                     help="max draft tokens per sequence per verify step "
                          "(default: EngineConfig default)")
-    ap.add_argument("--workload", default="uniform", choices=["uniform", "echo"],
+    ap.add_argument("--workload", default="uniform",
+                    choices=["uniform", "echo", "json"],
                     help="prompt distribution: uniform = distinct pseudo-random "
                          "streams (no lookup structure); echo = periodic "
                          "prompts whose continuations repeat — the shared-"
                          "prefix/agentic/summarization regime where prompt-"
-                         "lookup acceptance is high")
+                         "lookup acceptance is high; json = every request is "
+                         "schema-constrained (response_format json_schema) — "
+                         "prices the structured-outputs mask path end to end")
     args = ap.parse_args()
     tiny = args.tiny
     if args.cpu:
@@ -306,7 +309,28 @@ def main() -> None:
     load_s = time.monotonic() - t0
     print(f"# weights {weights_src} (loaded in {load_s:.1f}s)", file=sys.stderr)
 
-    sp = SamplingParams(max_tokens=osl, temperature=0.0, ignore_eos=True)
+    # json workload: every request is schema-constrained. The schema is fully
+    # bounded (enum/boolean/maxLength — a DAG grammar), so the mask forces
+    # completion; ignore_eos then keeps emitting EOS from the terminal state
+    # to fill osl, keeping token counts comparable across workloads. The
+    # longest serialization is 29 chars, under the tiny smoke's osl=32 —
+    # truncating a constrained row would count a violation per request.
+    bench_schema = {
+        "type": "object",
+        "properties": {"n": {"type": "string", "maxLength": 4},
+                       "c": {"enum": [0, 1, 2, 3, 4, 5, 6, 7]},
+                       "ok": {"type": "boolean"}},
+        "required": ["n", "c", "ok"],
+    }
+
+    def _sampling() -> SamplingParams:
+        kw = dict(max_tokens=osl, temperature=0.0, ignore_eos=True)
+        if args.workload == "json":
+            kw["response_format"] = {"type": "json_schema",
+                                     "json_schema": {"schema": bench_schema}}
+        return SamplingParams(**kw)
+
+    sp = _sampling()
 
     def prompts(n: int, salt: int):
         if args.workload == "echo":
@@ -331,7 +355,14 @@ def main() -> None:
         run_cfg.num_pages = max(run_cfg.num_pages, n_req * pages_per_seq + 64)
         run_cfg.max_model_len = max(run_cfg.max_model_len, isl + osl + lookahead + 1)
         t0 = time.monotonic()
-        eng = LLMEngine(cfg, run_cfg, params=params)
+        tok = None
+        if args.workload == "json":
+            from llmd_tpu.engine.tokenizer import load_tokenizer
+
+            # HF checkpoints carry their tokenizer; random weights mask over
+            # the byte fallback (same vocab the prompt generator draws from)
+            tok = load_tokenizer(model if params is not None else None)
+        eng = LLMEngine(cfg, run_cfg, params=params, tokenizer=tok)
         dev = jax.devices()[0]
         print(f"# engine built in {time.monotonic() - t0:.1f}s on {dev} "
               f"(NT={run_cfg.batched_tokens}, k={run_cfg.decode_steps})",
@@ -341,8 +372,7 @@ def main() -> None:
               file=sys.stderr)
         print(f"# moe_backend={eng.moe_backend}", file=sys.stderr)
         t0 = time.monotonic()
-        eng.generate(prompts(2, salt=1),
-                     SamplingParams(max_tokens=osl, temperature=0.0, ignore_eos=True))
+        eng.generate(prompts(2, salt=1), _sampling())
         print(f"# warmup/compile {time.monotonic() - t0:.1f}s", file=sys.stderr)
         # fresh stats for the measured window (every counter zeroed by construction)
         from llmd_tpu.engine.engine import EngineStats
@@ -512,6 +542,13 @@ def main() -> None:
           f"(prefill {st.total_prefill_tokens} toks, "
           f"decode {st.total_decode_tokens} toks, "
           f"preemptions {st.total_preemptions})", file=sys.stderr)
+    if st.structured_requests:
+        print(f"# structured: {st.structured_requests} constrained requests, "
+              f"{st.structured_mask_builds} mask builds in "
+              f"{st.time_mask_build:.3f}s host "
+              f"({st.time_mask_build / max(1, st.structured_mask_builds) * 1e6:.0f}"
+              f" us/build), violations {st.structured_violations}",
+              file=sys.stderr)
     if st.n_spec_verify_steps:
         print(f"# spec: drafted {st.spec_drafted}, accepted {st.spec_accepted}, "
               f"rejected {st.spec_rejected} over {st.n_spec_verify_steps} verify "
@@ -583,6 +620,12 @@ def main() -> None:
         "spec_accepted_per_verify_step": round(
             st.spec_accepted / st.n_spec_verify_steps, 3)
         if st.n_spec_verify_steps else None,
+        # structured-outputs provenance (--workload json): the host mask-build
+        # wall is the feature's per-step cost — compare against device_s
+        "structured_requests": st.structured_requests,
+        "structured_mask_builds": st.structured_mask_builds,
+        "structured_violations": st.structured_violations,
+        "mask_build_s": round(st.time_mask_build, 4),
     }))
 
 

@@ -147,9 +147,25 @@ class SamplingParams:
     repetition_penalty: float = 1.0
     ignore_eos: bool = False
     n: int = 1
+    # Structured outputs (llmd_tpu/structured): guided_* follow vLLM's guided
+    # decoding surface, response_format the OpenAI one ({"type": "json_object"
+    # | "json_schema", ...}). The engine compiles these to a token DFA whose
+    # per-step allow-mask rides the same device bias-add as logit_bias.
+    guided_choice: Optional[Sequence[str]] = None
+    guided_regex: Optional[str] = None
+    response_format: Optional[dict] = None
+    # OpenAI logit_bias: token id -> additive bias in [-100, 100]; -100 bans.
+    logit_bias: Optional[dict] = None
 
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+    def constrained(self) -> bool:
+        """True when decoding needs the biased sampler (grammar or bias)."""
+        return bool(self.guided_choice or self.guided_regex or self.logit_bias
+                    or (isinstance(self.response_format, dict)
+                        and self.response_format.get("type")
+                        in ("json_object", "json_schema")))
 
 
 class RequestOutcome(str, Enum):

@@ -123,6 +123,11 @@ class EngineConfig:
     # Suffix n-gram match lengths tried by the drafter, longest first.
     spec_ngram_max: int = 3
     spec_ngram_min: int = 1
+    # Structured outputs (llmd_tpu/structured): "auto" = compile grammars for
+    # requests that ask (guided_* / response_format / logit_bias ride the
+    # biased sampler; everything else keeps the exact unbiased programs),
+    # "off" = reject structured requests at admission (ValueError -> 400).
+    structured_mode: str = "auto"
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -36,8 +36,19 @@ from llmd_tpu.core.kv_events import KVEvent
 from llmd_tpu.core.request import SamplingParams
 from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.kv_manager import PageAllocator, Sequence
-from llmd_tpu.engine.sampling import greedy_tokens, sample_tokens
+from llmd_tpu.engine.sampling import (
+    greedy_tokens,
+    sample_tokens,
+    sample_tokens_biased,
+)
 from llmd_tpu.engine.spec import propose_ngram_draft
+from llmd_tpu.structured import (
+    NEG_BIAS,
+    StructuredState,
+    compile_grammar,
+    parse_logit_bias,
+    structured_spec,
+)
 from llmd_tpu.models.config import ModelConfig
 from llmd_tpu.obs.events import FlightRecorder
 from llmd_tpu.obs.metrics import Registry, register_engine_metrics
@@ -103,6 +114,15 @@ class EngineStats:
     spec_accepted: int = 0
     spec_rejected: int = 0
     n_spec_verify_steps: int = 0
+    # Structured outputs (llmd_tpu/structured): grammar-constrained requests
+    # admitted, host-side mask builds (time_mask_build is the feature's only
+    # per-step host cost — PERF.md compares it against step wall time), and
+    # tokens observed outside the grammar (should stay 0; truncated
+    # constrained generations count 1 at retirement).
+    structured_requests: int = 0
+    structured_mask_builds: int = 0
+    structured_violations: int = 0
+    time_mask_build: float = 0.0
 
 
 class LLMEngine:
@@ -115,9 +135,14 @@ class LLMEngine:
         params: Optional[dict[str, jax.Array]] = None,
         event_sink: Optional[Callable[[list[KVEvent]], None]] = None,
         seed: int = 0,
+        tokenizer: Optional[object] = None,
     ) -> None:
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
+        # Tokenizer for the structured-outputs vocab lift (structured/grammar):
+        # optional — engines serving only unconstrained requests never need it,
+        # and a structured request without one is rejected at add_request.
+        self.tokenizer = tokenizer
         self.mesh = build_mesh(engine_cfg.mesh) if engine_cfg.mesh.num_devices > 1 else None
         R = max(1, engine_cfg.dp_ranks)
         self.num_ranks = R
@@ -239,6 +264,10 @@ class LLMEngine:
         if engine_cfg.spec_mode not in ("off", "ngram"):
             raise ValueError(f"unknown spec_mode={engine_cfg.spec_mode!r} "
                              "(supported: 'off', 'ngram')")
+        if engine_cfg.structured_mode not in ("auto", "off"):
+            raise ValueError(
+                f"unknown structured_mode={engine_cfg.structured_mode!r} "
+                "(supported: 'auto', 'off')")
         # cumulative prefix-cache effectiveness (feeds the hit-ratio gauge)
         self._prefix_cached_total = 0
         self._prefix_prompt_total = 0
@@ -808,12 +837,42 @@ class LLMEngine:
                 if emb.shape != (k, self.model_cfg.hidden_size):
                     raise ValueError(f"mm embedding shape {emb.shape} != "
                                      f"({k}, {self.model_cfg.hidden_size})")
+        # Structured outputs: compile (or cache-fetch) the token grammar BEFORE
+        # any engine state mutates, so a malformed spec raises ValueError (the
+        # server's 400 path) without leaking a queued sequence.
+        logit_bias = parse_logit_bias(sampling.logit_bias)
+        structured: Optional[StructuredState] = None
+        compile_meta: Optional[tuple[str, bool, float]] = None
+        spec = structured_spec(sampling)
+        if spec is not None:
+            if self.cfg.structured_mode == "off":
+                raise ValueError(
+                    "structured outputs are disabled (structured_mode='off')")
+            if self.tokenizer is None:
+                raise ValueError(
+                    "structured request needs a tokenizer-equipped engine "
+                    "(LLMEngine(..., tokenizer=...))")
+            kind, payload = spec
+            tc0 = time.perf_counter()
+            grammar, cache_hit = compile_grammar(
+                kind, payload, self.tokenizer, self.model_cfg.vocab_size)
+            compile_s = time.perf_counter() - tc0
+            structured = StructuredState(grammar, kind)
+            compile_meta = (kind, cache_hit, compile_s)
+            self.stats.structured_requests += 1
+            m = self.metrics
+            m.structured_requests.labels(kind=kind).inc()
+            (m.structured_cache_hits if cache_hit
+             else m.structured_cache_misses).inc()
+            m.structured_compile_seconds.observe(compile_s)
         seq = Sequence(
             request_id=request_id, token_ids=list(token_ids), prompt_len=len(token_ids),
             max_tokens=sampling.max_tokens, sampling=sampling, lora_id=lora_id,
             lora_key=self._lora_hash_key(lora_id), arrival_time=time.monotonic(),
             rank=rank, mm_items=mm_items, trace_ctx=trace_ctx,
         )
+        seq.structured = structured
+        seq.logit_bias = logit_bias
         # pod state as a router would have observed it at arrival — joined with
         # the observed latencies at retirement into one predictor training row
         inflight = sum(
@@ -833,6 +892,11 @@ class LLMEngine:
                           trace_id=getattr(trace_ctx, "trace_id", "") or "")
         self.flight.record(request_id, "arrival", prompt_len=len(token_ids),
                            rank=rank, lora=lora_id)
+        if compile_meta is not None:
+            kind, cache_hit, compile_s = compile_meta
+            self.flight.record(request_id, "structured_compile", kind=kind,
+                               cache_hit=cache_hit,
+                               compile_ms=round(compile_s * 1e3, 3))
         if self.lora_registry is not None:
             self.lora_registry.on_waiting(lora_id)
 
@@ -1115,6 +1179,16 @@ class LLMEngine:
             # the mixed step reads host token state — apply any in-flight decode first
             self._flush_pending_decode()
             self._step_unified()
+        elif any(s is not None and (s.structured is not None or s.logit_bias)
+                 for s in self.running):
+            # Constrained rows (grammar mask / logit_bias) need the per-step
+            # host-built bias added before sampling; the fused decode program
+            # samples unbiased fully on-device, so batches carrying any
+            # constrained row decode through the unified step instead (it
+            # packs decode rows and samples via _sample_dispatch). Spec
+            # verify likewise never sees constrained rows.
+            self._flush_pending_decode()
+            self._step_unified()
         else:
             # decode builds its batch from host token state: the deferred
             # prefill sample (first tokens) must land first
@@ -1359,7 +1433,9 @@ class LLMEngine:
         # Mixed steps with decode rows apply synchronously: a deferred decode
         # row would sit out the following step, stalling steady-state ITL.
         prev, self._pending_sample = self._pending_sample, None
-        rec = self._sample_dispatch(sample_list, logits) if sample_list else None
+        bias = self._build_bias(sample_list, logits.shape) if sample_list else None
+        rec = (self._sample_dispatch(sample_list, logits, bias=bias)
+               if sample_list else None)
         if prev is not None:
             self._sample_apply(prev)
         if rec is not None:
@@ -1478,6 +1554,10 @@ class LLMEngine:
         may append, so k is bounded by the remaining max_tokens /
         max_model_len budget minus one (the bonus token is the plain-decode
         token and is always in budget)."""
+        if s.structured is not None or s.logit_bias:
+            # constrained rows never draft: the verify program samples
+            # greedily on-device without the grammar mask / bias
+            return []
         k = min(self.cfg.spec_tokens, max_draft,
                 s.max_tokens - s.num_generated - 1,
                 self.cfg.max_model_len - len(s.token_ids) - 1)
@@ -1794,6 +1874,15 @@ class LLMEngine:
         """Shared retirement path: free slot + pages, drop from the live map."""
         seq.finished = True
         seq.finish_reason = reason
+        if seq.structured is not None:
+            # final automaton sync: a constrained generation that ends before
+            # the grammar accepts (max_tokens/max_model_len truncation) is a
+            # violation from the client's point of view — the text won't parse
+            fresh = seq.structured.sync(seq.token_ids, seq.prompt_len)
+            n_bad = fresh + (0 if seq.structured.complete else 1)
+            if n_bad:
+                self.stats.structured_violations += n_bad
+                self.metrics.structured_violations.inc(n_bad)
         if seq.spec_drafted > 0:
             self.metrics.spec_acceptance.observe(
                 seq.spec_accepted / seq.spec_drafted)
@@ -1851,8 +1940,47 @@ class LLMEngine:
         self._free_seq(seq)
         self.seqs.pop(seq.request_id, None)
 
+    def _build_bias(self, rows_and_seqs: list[tuple[int, "Sequence"]],
+                    logits_shape: tuple) -> Optional[np.ndarray]:
+        """Host-side additive ``[B, V]`` bias for a sample batch: the grammar
+        allow-mask of each constrained row's current automaton state, plus any
+        OpenAI ``logit_bias`` entries. Returns None when the batch carries no
+        constrained row — the common case keeps the exact unbiased sampler
+        program (no bias upload, no second compile)."""
+        if not any(s.structured is not None or s.logit_bias
+                   for _, s in rows_and_seqs):
+            return None
+        t0 = time.perf_counter()
+        B, V = logits_shape[0], logits_shape[-1]
+        bias = np.zeros((B, V), np.float32)
+        for i, s in rows_and_seqs:
+            st = s.structured
+            if st is not None:
+                fresh = st.sync(s.token_ids, s.prompt_len)
+                if fresh:
+                    self.stats.structured_violations += fresh
+                    self.metrics.structured_violations.inc(fresh)
+                st.grammar.fill_bias(bias[i], st.state)
+                self.stats.structured_mask_builds += 1
+                if not st.mask_logged:
+                    st.mask_logged = True  # first mask only: timeline, not spam
+                    self.flight.record(
+                        s.request_id, "structured_mask", kind=st.kind,
+                        n_allowed=int(len(st.grammar.allowed_ids(st.state))))
+            if s.logit_bias:
+                row = bias[i]
+                for tid, b in s.logit_bias.items():
+                    if 0 <= tid < V:
+                        # OpenAI semantics: -100 is an outright ban
+                        row[tid] = NEG_BIAS if b <= -100.0 else row[tid] + b
+        dt = time.perf_counter() - t0
+        self.stats.time_mask_build += dt
+        self.metrics.structured_mask_seconds.observe(dt)
+        return bias
+
     def _sample_dispatch(self, rows_and_seqs: list[tuple[int, "Sequence"]],
-                         logits: jax.Array) -> dict:
+                         logits: jax.Array,
+                         bias: Optional[np.ndarray] = None) -> dict:
         """Launch sampling on device (chains on the step that made ``logits``)
         and start the device->host copy; no sync point here."""
         B = logits.shape[0]
@@ -1865,8 +1993,16 @@ class LLMEngine:
             tk[i] = sp.top_k
             tp[i] = sp.top_p
         self._key, sub = jax.random.split(self._key)
-        sampled = sample_tokens(logits.astype(jnp.float32), sub,
-                                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
+        if bias is not None:
+            # biased program: grammar masks / logit_bias add ON DEVICE before
+            # argmax — logits never leave the accelerator. Lazily jitted, so
+            # engines that never see a constrained request never compile it.
+            sampled = sample_tokens_biased(
+                logits.astype(jnp.float32), jnp.asarray(bias), sub,
+                jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
+        else:
+            sampled = sample_tokens(logits.astype(jnp.float32), sub,
+                                    jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp))
         try:
             sampled.copy_to_host_async()
         except (AttributeError, RuntimeError):
@@ -1888,6 +2024,11 @@ class LLMEngine:
                 continue  # aborted / preempted while the sample was in flight
             tok = int(sampled[i])
             s.token_ids.append(tok)
+            if s.structured is not None:
+                fresh = s.structured.sync(s.token_ids, s.prompt_len)
+                if fresh:  # masked sampling should make this unreachable
+                    self.stats.structured_violations += fresh
+                    self.metrics.structured_violations.inc(fresh)
             if s.first_token_time is None:
                 s.first_token_time = now
                 self.flight.record(

@@ -253,6 +253,14 @@ class Sequence:
     # per-request acceptance-rate summary observed at retirement.
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Structured outputs (llmd_tpu/structured): the per-sequence automaton
+    # cursor (StructuredState) when the request is grammar-constrained. The
+    # cursor derives from token_ids, which preemption preserves, so recompute
+    # resumes the automaton with no extra state handling.
+    structured: Optional[object] = None
+    # Static OpenAI logit_bias map (token id -> bias); rides the same device
+    # bias-add rows the grammar mask uses.
+    logit_bias: Optional[dict] = None
 
     @property
     def num_generated(self) -> int:

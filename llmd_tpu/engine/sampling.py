@@ -21,20 +21,14 @@ def greedy_tokens(logits: jax.Array) -> jax.Array:
     return jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("top_k_max",))
-def sample_tokens(
+def _sample_core(
     logits: jax.Array,  # [B, V] float32
     key: jax.Array,
     temperature: jax.Array,  # [B] (0 = greedy)
     top_k: jax.Array,  # [B] int32 (0 = disabled)
     top_p: jax.Array,  # [B] (1.0 = disabled)
-    top_k_max: int = 64,
+    top_k_max: int,
 ) -> jax.Array:
-    """Return sampled token ids [B].
-
-    top-k is bounded by static `top_k_max` (per-slot k masks within the top-k_max
-    candidates) to keep shapes static.
-    """
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -57,6 +51,42 @@ def sample_tokens(
     choice = jax.random.categorical(key, topv, axis=-1)  # [B] index into candidates
     sampled = jnp.take_along_axis(topi, choice[:, None], axis=1)[:, 0]
     return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+@partial(jax.jit, static_argnames=("top_k_max",))
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    key: jax.Array,
+    temperature: jax.Array,  # [B] (0 = greedy)
+    top_k: jax.Array,  # [B] int32 (0 = disabled)
+    top_p: jax.Array,  # [B] (1.0 = disabled)
+    top_k_max: int = 64,
+) -> jax.Array:
+    """Return sampled token ids [B].
+
+    top-k is bounded by static `top_k_max` (per-slot k masks within the top-k_max
+    candidates) to keep shapes static.
+    """
+    return _sample_core(logits, key, temperature, top_k, top_p, top_k_max)
+
+
+@partial(jax.jit, static_argnames=("top_k_max",))
+def sample_tokens_biased(
+    logits: jax.Array,  # [B, V] float32
+    bias: jax.Array,  # [B, V] float32 additive (0 allow / -1e9 ban / logit_bias)
+    key: jax.Array,
+    temperature: jax.Array,  # [B]
+    top_k: jax.Array,  # [B]
+    top_p: jax.Array,  # [B]
+    top_k_max: int = 64,
+) -> jax.Array:
+    """`sample_tokens` with an additive logit bias applied ON DEVICE before
+    argmax/sample — the grammar-mask / logit_bias path (llmd_tpu/structured).
+    A separate jitted program so engines that never see a structured request
+    never compile it (the spec.py lazy-jit pattern): `sample_tokens` keeps its
+    exact HLO, and unbiased batches stay bitwise identical."""
+    return _sample_core(logits + bias, key, temperature, top_k, top_p,
+                        top_k_max)
 
 
 def apply_penalties(

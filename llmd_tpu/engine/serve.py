@@ -69,6 +69,12 @@ def main() -> None:
     ap.add_argument("--spec-ngram-min", type=int,
                     default=int(os.environ.get("LLMD_SPEC_NGRAM_MIN", "1")),
                     help="shortest suffix n-gram the drafter falls back to")
+    ap.add_argument("--structured-mode",
+                    default=os.environ.get("LLMD_STRUCTURED_MODE", "auto"),
+                    choices=["auto", "off"],
+                    help="structured outputs (llmd_tpu/structured): 'auto' = "
+                         "compile grammars for requests that ask, 'off' = "
+                         "reject structured requests as 400")
     ap.add_argument("--enable-lora", action="store_true",
                     help="enable dynamic LoRA adapter serving")
     ap.add_argument("--max-loras", type=int, default=8)
@@ -115,6 +121,7 @@ def main() -> None:
         kv_layout=args.kv_layout,
         spec_mode=args.spec_mode, spec_tokens=args.spec_tokens,
         spec_ngram_max=args.spec_ngram_max, spec_ngram_min=args.spec_ngram_min,
+        structured_mode=args.structured_mode,
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig

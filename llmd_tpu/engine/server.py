@@ -43,6 +43,7 @@ from llmd_tpu.engine.config import EngineConfig
 from llmd_tpu.engine.engine import LLMEngine
 from llmd_tpu.engine.tokenizer import Tokenizer, load_tokenizer
 from llmd_tpu.models.config import ModelConfig
+from llmd_tpu.structured import validate_structured_body
 
 
 def _body_has_media(body: dict) -> bool:
@@ -63,6 +64,10 @@ def _sampling_from_body(body: dict) -> SamplingParams:
         presence_penalty=float(body.get("presence_penalty", 0.0)),
         frequency_penalty=float(body.get("frequency_penalty", 0.0)),
         ignore_eos=bool(body.get("ignore_eos", False)),
+        guided_choice=body.get("guided_choice"),
+        guided_regex=body.get("guided_regex"),
+        response_format=body.get("response_format"),
+        logit_bias=body.get("logit_bias"),
     )
 
 
@@ -111,12 +116,17 @@ class EngineServer:
                 raise ValueError("a shared engine requires the shared async_engine")
             self.engine = engine
             self.async_engine = async_engine
+            if engine.tokenizer is None:
+                # shared engines built without one still serve structured
+                # requests through this frontend's tokenizer
+                engine.tokenizer = self.tokenizer
             # this frontend's rank publishes its own KV events
             if rank < len(engine.allocs):
                 engine.allocs[rank].event_sink = self._on_kv_events
         else:
             self.engine = LLMEngine(model_cfg, engine_cfg, params=params,
-                                    event_sink=self._on_kv_events)
+                                    event_sink=self._on_kv_events,
+                                    tokenizer=self.tokenizer)
             self.async_engine = AsyncLLMEngine(self.engine)
         self._runner: Optional[web.AppRunner] = None
         self.request_count = 0
@@ -419,6 +429,12 @@ class EngineServer:
             body = await request.json()
         except Exception:
             return web.json_response({"error": {"message": "invalid JSON"}}, status=400)
+        try:
+            # malformed structured specs (bad schema/regex/logit_bias) fail as
+            # 400 here, before the request counts or touches the engine
+            validate_structured_body(body)
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
         self.request_count += 1
         mm_items = None
         if self.engine.model_cfg.mm_tokens > 0 and _body_has_media(body):
@@ -654,6 +670,15 @@ class EngineServer:
         }
         if body.get("ignore_eos"):
             chat_body["ignore_eos"] = True
+        # structured-output fields ride through to the shared sampling parse
+        for key in ("response_format", "guided_choice", "guided_regex",
+                    "logit_bias"):
+            if body.get(key) is not None:
+                chat_body[key] = body[key]
+        try:
+            validate_structured_body(chat_body)
+        except ValueError as e:
+            return web.json_response({"error": {"message": str(e)}}, status=400)
         # same tokenization path as chat (VL content parts included)
         mm_items = None
         if self.engine.model_cfg.mm_tokens > 0 and _body_has_media(chat_body):

@@ -66,6 +66,8 @@ EVENT_CATALOG = (
     "decode",
     "spec_draft",
     "spec_verify",
+    "structured_compile",
+    "structured_mask",
     "preempted",
     "kv_reload",
     "kv_offload",

@@ -523,6 +523,30 @@ class EngineMetrics:
         self.spec_acceptance = reg.summary(
             "llmd_tpu:spec_acceptance_rate",
             "Per-request draft acceptance rate, observed at retirement")
+        # Structured outputs (llmd_tpu/structured): grammar-constrained
+        # decoding with on-device logit masks.
+        self.structured_requests = reg.counter(
+            "llmd_tpu:structured_requests_total",
+            "Grammar-constrained requests admitted, by constraint kind",
+            labelnames=("kind",))
+        self.structured_compile_seconds = reg.histogram(
+            "llmd_tpu:structured_compile_seconds",
+            "Grammar compile time at admission (cache hits observe ~0)",
+            buckets=(0.0005, 0.002, 0.01, 0.05, 0.25, 1.0, 5.0))
+        self.structured_mask_seconds = reg.histogram(
+            "llmd_tpu:structured_mask_build_seconds",
+            "Host-side per-step bias build for constrained sample batches",
+            buckets=(0.0001, 0.0005, 0.002, 0.01, 0.05, 0.25))
+        self.structured_cache_hits = reg.counter(
+            "llmd_tpu:structured_cache_hits_total",
+            "Compiled-grammar LRU cache hits at admission")
+        self.structured_cache_misses = reg.counter(
+            "llmd_tpu:structured_cache_misses_total",
+            "Compiled-grammar LRU cache misses (fresh compiles) at admission")
+        self.structured_violations = reg.counter(
+            "llmd_tpu:structured_violations_total",
+            "Tokens observed outside the active grammar (incl. truncated "
+            "constrained generations counted at retirement)")
 
 
 class EngineServerMetrics:

@@ -86,9 +86,19 @@ def parse_openai_request(path: str, body: dict, headers: dict[str, str]) -> Infe
     else:
         req.prompt = str(body.get("prompt", ""))
     req.lora_adapter = body.get("lora_adapter")
+    # Structured outputs (llmd_tpu/structured): malformed specs fail here as
+    # ValueError -> 400, BEFORE the request ever reaches flow control; valid
+    # specs ride through in sampling so scorers/predictors can see them.
+    from llmd_tpu.structured import validate_structured_body
+
+    validate_structured_body(body)
     req.sampling = SamplingParams(
         max_tokens=int(body.get("max_output_tokens", body.get("max_tokens", 16))),
         temperature=float(body.get("temperature", 1.0)),
+        guided_choice=body.get("guided_choice"),
+        guided_regex=body.get("guided_regex"),
+        response_format=body.get("response_format"),
+        logit_bias=body.get("logit_bias"),
     )
     req.streaming = bool(body.get("stream", False))
     req.byte_size = len(json.dumps(body))
@@ -596,7 +606,11 @@ class RouterServer:
         from llmd_tpu.obs.tracing import extract_traceparent
 
         if request.path.endswith("/v1/responses") and body.get("conversation"):
-            req = self.prepare_request(request.path, body, headers)
+            try:
+                req = self.prepare_request(request.path, body, headers)
+            except ValueError as e:  # malformed structured spec → 400 pre-flow
+                return web.json_response({"error": {"message": str(e)}},
+                                         status=400)
             # span BEFORE the flow gate (parity with the scheduled path) so
             # the flight record carries a trace id from its first event on
             span = self.tracer.start_span(
@@ -662,7 +676,10 @@ class RouterServer:
                                    status="finished", http_status=resp.status)
             span.end()
             return resp
-        req = self.prepare_request(request.path, body, headers)
+        try:
+            req = self.prepare_request(request.path, body, headers)
+        except ValueError as e:  # malformed structured spec → 400 pre-flow
+            return web.json_response({"error": {"message": str(e)}}, status=400)
 
         span = self.tracer.start_span(
             "epp.request", parent=extract_traceparent(headers),

@@ -57,6 +57,9 @@ def main() -> int:
         ("lint-events", [py, "tools/lint_events.py"], CPU_ENV),
         ("validate-manifests", [py, "tools/validate_manifests.py", "deploy"], None),
         ("chaos-check", [py, "tools/chaos_check.py"], CPU_ENV),
+        # structured outputs: constrained generations must conform 100% and
+        # malformed schemas must 400 before admission
+        ("structured-check", [py, "tools/structured_check.py"], CPU_ENV),
     ]
     if not args.skip_tests:
         pytest_cmd = [py, "-m", "pytest", "tests/", "-q"]

@@ -48,6 +48,13 @@ POINTS: list[tuple[str, list[str]]] = [
                        "--spec-mode", "ngram"]),
     ("int8-b64-spec-echo", ["--quantize", "int8", "--batch", "64",
                             "--spec-mode", "ngram", "--workload", "echo"]),
+    # structured-outputs A/B vs the int8-b64 row: every request schema-
+    # constrained (response_format json_schema), so the point prices the
+    # grammar-mask path end to end — host mask builds + the biased sampler +
+    # the unified-step degrade (constrained rows can't ride fused decode).
+    # Like the spec echo row, excluded from best_serving (different workload).
+    ("int8-b64-structured", ["--quantize", "int8", "--batch", "64",
+                             "--workload", "json"]),
     ("int8-b64-unroll4", ["--quantize", "int8", "--batch", "64",
                           "--layer-unroll", "4"]),
     ("int8-b64-unroll16", ["--quantize", "int8", "--batch", "64",

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Structured-outputs gate: schema-constrained serving through the real stack.
+
+Spins one in-process EngineServer (tiny model, CPU, byte tokenizer) and
+drives N schema-constrained chat completions plus guided_choice/guided_regex
+requests through the OpenAI surface. The gate holds when:
+
+- every constrained response is 200 AND its content parses/validates against
+  the constraint it was issued under (100% conformance, not a ratio),
+- a malformed schema and a malformed logit_bias answer 400 (never 5xx),
+- zero 5xx anywhere.
+
+Run: python tools/structured_check.py  (CI: tools/ci_gate.py stage
+`structured-check`, also `make structured`)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import re
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_SCHEMA_REQUESTS = 8
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "name": {"type": "string", "maxLength": 8},
+        "count": {"enum": [0, 1, 2, 3]},
+        "ok": {"type": "boolean"},
+    },
+    "required": ["name", "count", "ok"],
+}
+CHOICES = ["alpha", "beta", "gamma"]
+REGEX = r"[a-c]{3}-[0-9]{2}"
+
+
+async def main_async() -> int:
+    import aiohttp
+
+    from llmd_tpu.engine.config import EngineConfig
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.models import get_model_config
+    from llmd_tpu.structured import validate_instance
+
+    server = EngineServer(
+        get_model_config("tiny"),
+        EngineConfig(page_size=8, num_pages=128, max_model_len=256,
+                     max_batch_size=4, prefill_chunk=32),
+        model_name="llmd-tpu/tiny", port=0)
+    await server.start()
+
+    statuses: dict[int, int] = {}
+    bad: list[str] = []
+    t0 = time.monotonic()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async def chat(body: dict) -> tuple[int, str]:
+                body = {"model": "llmd-tpu/tiny", "max_tokens": 64,
+                        "temperature": 0.0, **body}
+                async with sess.post(
+                    f"http://{server.address}/v1/chat/completions", json=body,
+                    timeout=aiohttp.ClientTimeout(total=120),
+                ) as r:
+                    statuses[r.status] = statuses.get(r.status, 0) + 1
+                    if r.status != 200:
+                        return r.status, await r.text()
+                    data = await r.json()
+                    return 200, data["choices"][0]["message"]["content"]
+
+            # N schema-constrained requests (varied prompts; temperature 0.7
+            # on half so the sampled path is exercised too)
+            for i in range(N_SCHEMA_REQUESTS):
+                status, text = await chat({
+                    "messages": [{"role": "user",
+                                  "content": f"emit record {i} " * (i + 1)}],
+                    "temperature": 0.7 if i % 2 else 0.0,
+                    "seed": i,
+                    "response_format": {"type": "json_schema",
+                                        "json_schema": {"schema": SCHEMA}},
+                })
+                if status != 200:
+                    bad.append(f"schema[{i}]: HTTP {status}: {text[:200]}")
+                    continue
+                try:
+                    value = json.loads(text)
+                except ValueError:
+                    bad.append(f"schema[{i}]: not JSON: {text!r}")
+                    continue
+                if not validate_instance(value, SCHEMA):
+                    bad.append(f"schema[{i}]: fails schema: {value!r}")
+
+            status, text = await chat({
+                "messages": [{"role": "user", "content": "pick one"}],
+                "guided_choice": CHOICES,
+            })
+            if status != 200 or text not in CHOICES:
+                bad.append(f"choice: HTTP {status}: {text!r}")
+            status, text = await chat({
+                "messages": [{"role": "user", "content": "match it"}],
+                "guided_regex": REGEX,
+            })
+            if status != 200 or not re.fullmatch(REGEX, text):
+                bad.append(f"regex: HTTP {status}: {text!r}")
+
+            # malformed inputs must answer 400 (and never reach the engine)
+            for label, body in (
+                ("bad-schema", {"messages": [{"role": "user", "content": "x"}],
+                                "response_format": {
+                                    "type": "json_schema",
+                                    "json_schema": {"schema": {
+                                        "type": "object",
+                                        "properties": {"x": {"type": "wat"}},
+                                        "required": ["x"]}}}}),
+                ("bad-rf-type", {"messages": [{"role": "user", "content": "x"}],
+                                 "response_format": {"type": "yaml_object"}}),
+                ("bad-logit-bias", {"messages": [{"role": "user",
+                                                  "content": "x"}],
+                                    "logit_bias": {"7": 9000}}),
+            ):
+                status, text = await chat(body)
+                if status != 400:
+                    bad.append(f"{label}: expected 400, got {status}: "
+                               f"{text[:200]}")
+    finally:
+        await server.stop()
+
+    wall = time.monotonic() - t0
+    n_5xx = sum(n for code, n in statuses.items() if code >= 500)
+    verdict = not bad and n_5xx == 0
+    print(json.dumps({
+        "structured_check": "ok" if verdict else "failed",
+        "schema_requests": N_SCHEMA_REQUESTS,
+        "statuses": {str(k): v for k, v in sorted(statuses.items())},
+        "failures": bad,
+        "wall_s": round(wall, 2),
+    }, indent=2))
+    if not verdict:
+        print(f"structured_check: FAILED — {len(bad)} failures, "
+              f"{n_5xx} 5xx", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main() -> int:
+    return asyncio.run(main_async())
+
+
+if __name__ == "__main__":
+    sys.exit(main())
