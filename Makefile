@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos structured
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -33,6 +33,10 @@ chaos:
 # grammar-constrained decoding: 100% conformance, malformed schemas -> 400
 structured:
 	JAX_PLATFORMS=cpu $(PY) tools/structured_check.py
+
+# autoscaling SLO gate: 10x burst + replica chaos, zero 5xx, warm 0->1
+slo:
+	JAX_PLATFORMS=cpu $(PY) tools/slo_check.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -80,6 +80,11 @@ def main() -> None:
     ap.add_argument("--max-loras", type=int, default=8)
     ap.add_argument("--max-lora-rank", type=int, default=8)
     ap.add_argument("--cpu", action="store_true", help="force CPU platform (dev)")
+    ap.add_argument("--compile-cache-dir", default=None,
+                    help="persistent JAX compilation cache dir; the pool "
+                         "controller's warm-start path points relaunches at "
+                         "the snapshot's cache so compiled programs "
+                         "deserialize instead of re-tracing")
     ap.add_argument("--predictor-train-url", default=None,
                     help="latency-predictor training server base URL; completed "
                          "requests' TTFT/TPOT rows stream to its POST /samples")
@@ -99,6 +104,11 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if args.compile_cache_dir:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", args.compile_cache_dir)
 
     from llmd_tpu.engine.config import EngineConfig
     from llmd_tpu.engine.server import EngineServer

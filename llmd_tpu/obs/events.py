@@ -75,6 +75,11 @@ EVENT_CATALOG = (
     "aborted",
     "drain_start",
     "drain_done",
+    # pool plane (pool/controller.py replica lifecycle; system events —
+    # replica churn has no owning request)
+    "pool_scale_up",
+    "pool_scale_down",
+    "pool_warm_start",
 )
 
 _TERMINAL_STATUS = {"finished", "aborted", "rejected", "error"}

@@ -1,0 +1,253 @@
+"""Replica launchers: the pool controller's process-lifecycle backends.
+
+Two implementations of one contract:
+
+- :class:`FakeReplicaLauncher` — in-process ``FakeModelServer`` replicas for
+  CI and the SLO gate. A configurable ``engine_build_s`` sleep simulates the
+  cold engine build; a snapshot hit (``PoolSnapshotStore``) skips it, which
+  is exactly the warm-start contract the engine path honors for real.
+- :class:`ProcessReplicaLauncher` — subprocess replicas (``testing/
+  fake_server.py`` CLI or ``engine/serve.py`` via :func:`engine_argv`),
+  readiness-gated on ``/health``.
+
+``kill`` is deliberately part of the contract: chaos tooling
+(tools/slo_check.py) needs to take a replica down *without* the drain
+handshake, so the controller's health probe and the router's breakers — not
+the launcher — have to notice.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import os
+import socket
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from llmd_tpu.pool.snapshot import PoolSnapshotStore, config_fingerprint
+
+
+@dataclass
+class ReplicaHandle:
+    """One launched replica, as the controller tracks it."""
+
+    address: str  # "host:port" the replica serves on
+    name: str = ""
+    warm: bool = False  # launched from a snapshot (skipped cold build)
+    launched_at: float = field(default_factory=time.monotonic)
+    server: Any = None  # in-process FakeModelServer (fake launcher)
+    proc: Any = None  # subprocess.Popen (process launcher)
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            self.name = self.address
+
+
+class ReplicaLauncher:
+    """Lifecycle contract the controller drives. All methods are async so
+    process launchers can await readiness without blocking the loop."""
+
+    async def launch(self) -> ReplicaHandle:
+        raise NotImplementedError
+
+    async def stop(self, handle: ReplicaHandle) -> None:
+        """Graceful stop (the controller drains via the router first)."""
+        raise NotImplementedError
+
+    async def kill(self, handle: ReplicaHandle) -> None:
+        """Abrupt stop: no drain, in-flight requests die. Chaos only."""
+        await self.stop(handle)
+
+    def alive(self, handle: ReplicaHandle) -> bool:
+        raise NotImplementedError
+
+
+class FakeReplicaLauncher(ReplicaLauncher):
+    """In-process fake replicas with a simulated cold engine build.
+
+    ``engine_config`` is fingerprinted exactly like the engine launcher's;
+    the first launch pays ``engine_build_s`` and commits a snapshot, every
+    later launch of the same config is warm (pays only ``restore_s``).
+    """
+
+    def __init__(self, server_config=None,
+                 snapshots: Optional[PoolSnapshotStore] = None,
+                 engine_config: Optional[dict] = None,
+                 engine_build_s: float = 0.0,
+                 restore_s: float = 0.0) -> None:
+        from llmd_tpu.testing.fake_server import FakeServerConfig
+
+        self.server_config = server_config or FakeServerConfig()
+        self.snapshots = snapshots
+        self.engine_config = engine_config if engine_config is not None else {
+            "model": self.server_config.model,
+            "block_size": self.server_config.block_size,
+            "num_blocks": self.server_config.num_blocks,
+        }
+        self.engine_build_s = engine_build_s
+        self.restore_s = restore_s
+        self._seq = 0
+
+    async def launch(self) -> ReplicaHandle:
+        from llmd_tpu.testing.fake_server import FakeModelServer
+
+        fp = config_fingerprint(self.engine_config)
+        warm = self.snapshots is not None and self.snapshots.has(fp)
+        if warm:
+            if self.restore_s > 0:
+                await asyncio.sleep(self.restore_s)
+        else:
+            if self.engine_build_s > 0:
+                await asyncio.sleep(self.engine_build_s)  # simulated build
+            if self.snapshots is not None:
+                self.snapshots.save(fp, {"kind": "fake",
+                                         "engine_config": self.engine_config})
+        server = FakeModelServer(copy.deepcopy(self.server_config))
+        await server.start()
+        self._seq += 1
+        return ReplicaHandle(address=server.address,
+                             name=f"fake-{self._seq}", warm=warm,
+                             server=server)
+
+    async def stop(self, handle: ReplicaHandle) -> None:
+        if handle.server is not None:
+            await handle.server.stop()
+            handle.server = None
+
+    async def kill(self, handle: ReplicaHandle) -> None:
+        # aiohttp cleanup cancels in-flight handlers: clients see resets,
+        # which is the abrupt-death signal the chaos gate wants
+        await self.stop(handle)
+
+    def alive(self, handle: ReplicaHandle) -> bool:
+        return handle.server is not None and handle.server._runner is not None
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def fake_argv(port: int, *, model: str = "fake/model", block_size: int = 16,
+              num_blocks: int = 512, max_running: int = 8,
+              decode_us_per_token: float = 500.0) -> list[str]:
+    """argv for a subprocess FakeModelServer (testing/fake_server.py CLI)."""
+    return [sys.executable, "-m", "llmd_tpu.testing.fake_server",
+            "--port", str(port), "--model", model,
+            "--block-size", str(block_size), "--num-blocks", str(num_blocks),
+            "--max-running", str(max_running),
+            "--decode-us-per-token", str(decode_us_per_token)]
+
+
+def engine_argv(model: str, port: int,
+                snapshots: Optional[PoolSnapshotStore] = None,
+                engine_config: Optional[dict] = None,
+                extra: Optional[list[str]] = None) -> tuple[list[str], bool]:
+    """argv for an ``engine/serve.py`` replica, warm-start aware.
+
+    With a snapshot store, the materialized checkpoint and the persistent
+    JAX compilation cache live under the config fingerprint: the first
+    launch builds the checkpoint (testing/checkpoints.py for test models,
+    a straight copy of HF dirs otherwise happens at serve time) and every
+    relaunch reuses both — serve deserializes compiled programs instead of
+    tracing them. Returns ``(argv, warm)``.
+    """
+    cfg = dict(engine_config or {})
+    cfg.setdefault("model", model)
+    argv = [sys.executable, "-m", "llmd_tpu.engine.serve",
+            "--model", model, "--port", str(port)]
+    warm = False
+    if snapshots is not None:
+        fp = config_fingerprint(cfg)
+        warm = snapshots.has(fp)
+        cache_dir = snapshots.path(fp, "compile_cache")
+        if not os.path.isdir(model):  # test-model name → materialize once
+            ckpt_dir = snapshots.path(fp, "checkpoint")
+            if not os.path.exists(os.path.join(ckpt_dir, "config.json")):
+                from llmd_tpu.testing.checkpoints import make_hf_checkpoint
+
+                make_hf_checkpoint(ckpt_dir)
+            argv[argv.index("--model") + 1] = ckpt_dir
+        argv += ["--compile-cache-dir", cache_dir]
+        if not warm:
+            snapshots.save(fp, {"kind": "engine", "engine_config": cfg})
+    argv += list(extra or [])
+    return argv, warm
+
+
+class ProcessReplicaLauncher(ReplicaLauncher):
+    """Subprocess replicas readiness-gated on ``/health``.
+
+    ``argv_fn(port) -> (argv, warm)`` (or ``argv`` alone, treated as cold)
+    decouples the launcher from what it launches: ``fake_argv`` for CI,
+    ``engine_argv`` for on-device pools.
+    """
+
+    def __init__(self, argv_fn: Callable[[int], Any], host: str = "127.0.0.1",
+                 ready_timeout_s: float = 60.0,
+                 env: Optional[dict[str, str]] = None) -> None:
+        self.argv_fn = argv_fn
+        self.host = host
+        self.ready_timeout_s = ready_timeout_s
+        self.env = env
+        self._seq = 0
+
+    async def launch(self) -> ReplicaHandle:
+        import subprocess
+
+        import aiohttp
+
+        port = _free_port(self.host)
+        built = self.argv_fn(port)
+        argv, warm = built if isinstance(built, tuple) else (built, False)
+        env = dict(os.environ, **(self.env or {}))
+        proc = subprocess.Popen(argv, env=env,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        address = f"{self.host}:{port}"
+        deadline = time.monotonic() + self.ready_timeout_s
+        async with aiohttp.ClientSession() as sess:
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"replica process exited rc={proc.returncode} "
+                        f"before becoming ready ({' '.join(argv[:4])}…)")
+                try:
+                    async with sess.get(
+                        f"http://{address}/health",
+                        timeout=aiohttp.ClientTimeout(total=1.0),
+                    ) as r:
+                        if r.status == 200:
+                            self._seq += 1
+                            return ReplicaHandle(address=address,
+                                                 name=f"proc-{self._seq}",
+                                                 warm=warm, proc=proc)
+                except Exception:
+                    pass
+                await asyncio.sleep(0.05)
+        proc.kill()
+        raise TimeoutError(
+            f"replica at {address} not ready within {self.ready_timeout_s}s")
+
+    async def stop(self, handle: ReplicaHandle) -> None:
+        if handle.proc is None:
+            return
+        handle.proc.terminate()
+        try:
+            await asyncio.to_thread(handle.proc.wait, 5.0)
+        except Exception:
+            handle.proc.kill()
+        handle.proc = None
+
+    async def kill(self, handle: ReplicaHandle) -> None:
+        if handle.proc is not None:
+            handle.proc.kill()
+            await asyncio.to_thread(handle.proc.wait)
+            handle.proc = None
+
+    def alive(self, handle: ReplicaHandle) -> bool:
+        return handle.proc is not None and handle.proc.poll() is None

@@ -114,6 +114,14 @@ class MetricsPoller:
         # failure — the breaker's passive-health signal (router attaches it)
         self.on_scrape_error = None
 
+    def forget(self, address: str) -> None:
+        """Drop an endpoint's error-count keys when it leaves discovery —
+        scale-cycle churn must not grow the map without bound."""
+        self.error_counts.pop(address, None)
+        for key in [k for k in self.error_counts
+                    if k.startswith(address + ":")]:
+            del self.error_counts[key]
+
     async def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())
 

@@ -269,6 +269,15 @@ class ResilienceManager:
             ):
                 self._transition(address, br, BreakerState.OPEN, reason=reason)
 
+    def forget(self, address: str) -> None:
+        """Drop every trace of an endpoint that left discovery. Replica
+        churn (pool scale cycles) would otherwise grow the breaker map and
+        the draining set without bound — and a re-used address would
+        inherit a dead replica's open breaker."""
+        with self._lock:
+            self._breakers.pop(address, None)
+            self._draining.discard(address)
+
     def note_scrape_error(self, address: str) -> None:
         """Metrics-scrape failure: a passive health signal. An endpoint whose
         /metrics stops answering is almost always one whose serving path is

@@ -255,6 +255,16 @@ class RouterServer:
             lambda: self.poller.scrape_error_count)
         self.metrics.breaker_open_endpoints.set_function(
             lambda: len(self.resilience.open_endpoints()))
+        # Discovery eviction: an endpoint leaving the pool (scale-down,
+        # replica death) takes its breaker/draining/error-count state with
+        # it — churned replicas must not leak state across scale cycles.
+        def _on_pool_event(kind: str, ep) -> None:
+            if kind == "removed":
+                self.resilience.forget(ep.address)
+                self.poller.forget(ep.address)
+
+        self._pool_listener = _on_pool_event
+        pool.subscribe(self._pool_listener)
         # extra Prometheus providers (ext-proc EPP front, HA coordinator, ...):
         # callables returning lines, appended to /metrics
         self.extra_metrics: list[Any] = []
@@ -294,6 +304,7 @@ class RouterServer:
         self.port = site._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
+        self.pool.unsubscribe(self._pool_listener)
         await self.poller.stop()
         if self.flow:
             await self.flow.stop()

@@ -69,6 +69,11 @@ class FaultConfig:
     error_status: int = 503
     connect_refuse: bool = False  # kill the connection instead of answering
     latency_s: float = 0.0  # added latency before each generate request
+    # slow-replica injection (SLO harness): stretch the timing model instead
+    # of failing outright — the router sees a healthy-but-slow endpoint
+    first_byte_delay_s: float = 0.0  # added to the prefill phase (TTFT)
+    decode_delay_s: float = 0.0  # added per generated token (ITL)
+    jitter_s: float = 0.0  # uniform [0, jitter] extra on each injected delay
     midstream_hangup_rate: float = 0.0  # streaming: cut after the first chunk
     flap_period_s: float = 0.0  # >0: alternate up/down on this period
     flap_duty: float = 0.5  # fraction of each period the server is UP
@@ -191,6 +196,13 @@ class FakeModelServer:
         if "flap_period_s" in kw:
             self._flap_t0 = time.monotonic()
 
+    def _injected_delay(self, base_s: float) -> float:
+        """A latency-knob value plus its jitter draw (seeded RNG, so runs
+        replay). Jitter only applies where a base delay is configured."""
+        if base_s <= 0:
+            return 0.0
+        return base_s + self._fault_rng.uniform(0.0, self.faults.jitter_s)
+
     def _flap_down(self) -> bool:
         f = self.faults
         if f.flap_period_s <= 0:
@@ -246,8 +258,10 @@ class FakeModelServer:
             try:
                 cached = await self._touch_blocks(token_ids, lora)
                 uncached = max(0, len(token_ids) - cached)
-                prefill_s = uncached * self.cfg.prefill_us_per_token / 1e6
-                tpot_s = self.cfg.decode_us_per_token / 1e6
+                prefill_s = (uncached * self.cfg.prefill_us_per_token / 1e6
+                             + self._injected_delay(self.faults.first_byte_delay_s))
+                tpot_s = (self.cfg.decode_us_per_token / 1e6
+                          + self._injected_delay(self.faults.decode_delay_s))
                 # kv_transfer_params flow for P/D (disaggregation/README.md:104-131).
                 kv_params = body.get("kv_transfer_params") or {}
                 rid = f"cmpl-{uuid.uuid4().hex[:12]}"
@@ -394,3 +408,46 @@ class FakeModelServer:
         data = [{"id": self.cfg.model, "object": "model"}]
         data += [{"id": a, "object": "model", "parent": self.cfg.model} for a in self.cfg.lora_adapters]
         return web.json_response({"object": "list", "data": data})
+
+
+def main() -> int:
+    """CLI: run one fake replica as a standalone process — the pool
+    controller's ProcessReplicaLauncher target for hardware-free runs."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description="standalone FakeModelServer")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--model", default="fake/model")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=512)
+    ap.add_argument("--max-running", type=int, default=8)
+    ap.add_argument("--prefill-us-per-token", type=float, default=50.0)
+    ap.add_argument("--decode-us-per-token", type=float, default=500.0)
+    ap.add_argument("--role", default="both",
+                    choices=["prefill", "decode", "both"])
+    args = ap.parse_args()
+
+    cfg = FakeServerConfig(
+        model=args.model, block_size=args.block_size,
+        num_blocks=args.num_blocks, max_running=args.max_running,
+        prefill_us_per_token=args.prefill_us_per_token,
+        decode_us_per_token=args.decode_us_per_token, role=args.role)
+    server = FakeModelServer(cfg, host=args.host, port=args.port)
+
+    async def run() -> None:
+        await server.start()
+        print(f"fake model server on http://{server.address}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -176,6 +176,110 @@ def test_engine_scale_from_zero_and_reconcile():
     assert eng.decisions[-1] == ("m", "v", 1)
 
 
+def test_hpa_tolerance_band_edges():
+    hpa = HPAEvaluator(min_replicas=1, max_replicas=20, tolerance=0.1)
+    # Value metric (queue target 8) at 4 replicas: just inside the ±10% band
+    assert hpa.desired_replicas(4, {"igw_queue_depth": 8.75}) == 4
+    # just past the band → ceil(ratio * current) fires
+    assert hpa.desired_replicas(4, {"igw_queue_depth": 8.81}) == 5
+    # lower side inside the band holds too
+    assert hpa.desired_replicas(4, {"igw_queue_depth": 7.25}) == 4
+    # just below the band, ceil still rounds the desired count back up —
+    # downscale only materializes once the ratio clears the ceil boundary
+    assert hpa.desired_replicas(4, {"igw_queue_depth": 7.19}) == 4
+    assert hpa.desired_replicas(4, {"igw_queue_depth": 6.0}) == 3
+    # the band check is INCLUSIVE (|ratio-1| <= tol): prove it at an exactly
+    # representable edge — tol 0.125, queue 9 → ratio 9/8 = 1.125 on the nose
+    edge = HPAEvaluator(min_replicas=1, max_replicas=20, tolerance=0.125)
+    assert edge.desired_replicas(4, {"igw_queue_depth": 9.0}) == 4
+    assert edge.desired_replicas(4, {"igw_queue_depth": 9.01}) == 5
+    # AverageValue metric (running target 16/replica): same band semantics
+    assert hpa.desired_replicas(4, {"igw_running_requests": 70.0}) == 4
+    assert hpa.desired_replicas(4, {"igw_running_requests": 70.5}) == 5
+    # the band never overrides the min/max clamps
+    assert hpa.desired_replicas(1, {"igw_queue_depth": 0.0}) == 1
+    assert hpa.desired_replicas(20, {"igw_queue_depth": 8.0 * 21}) == 20
+
+
+# /metrics scrapes recorded from a live fake-server pool (the exact text the
+# MetricsPoller hands to parse_prometheus → map_engine_metrics): one idle
+# replica, and one under queue pressure during a burst.
+RECORDED_IDLE = """\
+# HELP vllm:num_requests_waiting Number of requests waiting to be processed.
+vllm:num_requests_waiting 0.0
+vllm:num_requests_running 0.0
+vllm:kv_cache_usage_perc 0.0117
+vllm:cache_config_info{block_size="16",num_gpu_blocks="512"} 1.0
+"""
+RECORDED_SATURATED = """\
+vllm:num_requests_waiting 9.0
+vllm:num_requests_running 4.0
+vllm:kv_cache_usage_perc 0.9613
+vllm:cache_config_info{block_size="16",num_gpu_blocks="512"} 1.0
+"""
+
+
+def _recorded_pool(text: str, n: int, epp_queue: float,
+                   in_retention: float) -> PoolMetrics:
+    """Recorded scrape → Endpoint attrs → ReplicaMetrics, through the same
+    datalayer mapping the live controller uses."""
+    from llmd_tpu.core.endpoint import Endpoint
+    from llmd_tpu.core.metrics_contract import map_engine_metrics, parse_prometheus
+    from llmd_tpu.pool.controller import replica_metrics_from_endpoint
+
+    reps = []
+    for i in range(n):
+        ep = Endpoint(address=f"10.0.0.{i}:8000")
+        for k, v in map_engine_metrics("vllm", parse_prometheus(text)).items():
+            ep.attrs.put(k, v)
+        reps.append(replica_metrics_from_endpoint(ep))
+    return PoolMetrics(replicas={"v": reps}, epp_queue_size=epp_queue,
+                       requests_in_retention=in_retention)
+
+
+def test_wva_scale_from_zero_from_recorded_metrics():
+    scaled = []
+    v = Variant(name="v", model_id="m", cost=1, min_replicas=0, max_replicas=4,
+                current_replicas=0, desired_replicas=0,
+                scale=lambda n: scaled.append(n))
+    state = {"queue": 0.0}
+    eng = WVAEngine(
+        pools={"m": [v]},
+        metrics_fn=lambda mid: _recorded_pool(
+            RECORDED_IDLE, 0, state["queue"], in_retention=1.0))
+    eng.scale_from_zero_step()
+    assert scaled == []  # empty pool, empty queue: stays down
+    state["queue"] = 3.0  # flow control holding requests at the empty pool
+    eng.scale_from_zero_step()
+    assert scaled == [1] and v.desired_replicas == 1
+
+
+def test_wva_scale_to_zero_from_recorded_metrics():
+    v = Variant(name="v", model_id="m", cost=1, min_replicas=0, max_replicas=4,
+                current_replicas=2, desired_replicas=2)
+    state = {"text": RECORDED_SATURATED, "retention": 1.0}
+    eng = WVAEngine(
+        pools={"m": [v]},
+        metrics_fn=lambda mid: _recorded_pool(
+            state["text"], v.current_replicas, 0.0, state["retention"]),
+        enforcer=Enforcer(scale_to_zero=True, retention_s=60),
+    )
+    eng.step()
+    assert v.desired_replicas == 3  # recorded burst scrape reads saturated
+    v.current_replicas = v.desired_replicas  # launches reconciled
+    # burst over: idle scrape but retention window still holds traffic
+    state["text"] = RECORDED_IDLE
+    eng.step()
+    assert v.desired_replicas >= 1
+    # retention expired → the enforcer zeroes the pool
+    state["retention"] = 0.0
+    v.current_replicas = v.desired_replicas
+    for _ in range(4):  # spare-capacity downscale is one replica per step
+        eng.step()
+        v.current_replicas = v.desired_replicas
+    assert v.desired_replicas == 0
+
+
 def test_hpa_dual_metric_max():
     hpa = HPAEvaluator(min_replicas=1, max_replicas=20)
     # queue 32 vs target 8 at 2 replicas → Value path wants ceil(2*32/8)=8

@@ -68,3 +68,61 @@ async def _scenario():
 
 def test_fake_server_contracts():
     run_async(_scenario())
+
+
+async def _latency_knob_scenario():
+    srv = FakeModelServer(FakeServerConfig(prefill_us_per_token=0.0,
+                                           decode_us_per_token=0.0))
+    await srv.start()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async def timed(max_tokens=4):
+                import time
+
+                t0 = time.monotonic()
+                r = await sess.post(
+                    f"http://{srv.address}/v1/completions",
+                    json={"prompt": "knob test", "max_tokens": max_tokens,
+                          "model": "fake/model"},
+                )
+                assert r.status == 200
+                await r.json()
+                return time.monotonic() - t0
+
+            baseline = await timed()
+            assert baseline < 0.1  # zero-cost config: effectively instant
+
+            # first_byte_delay_s lands once, in the prefill phase
+            srv.set_faults(first_byte_delay_s=0.15)
+            assert await timed() >= 0.15
+            # decode_delay_s lands per generated token
+            srv.set_faults(first_byte_delay_s=0.0, decode_delay_s=0.03)
+            assert await timed(max_tokens=5) >= 0.15
+            # knobs reset cleanly
+            srv.set_faults(decode_delay_s=0.0)
+            assert await timed() < 0.1
+    finally:
+        await srv.stop()
+
+
+def test_fake_server_latency_knobs():
+    run_async(_latency_knob_scenario())
+
+
+def test_fake_server_jitter_bounds():
+    srv = FakeModelServer(FakeServerConfig())
+    srv.set_faults(jitter_s=0.2)
+    # jitter only rides on an injected delay — a zero base stays zero, so
+    # enabling jitter alone never slows an un-delayed phase
+    assert srv._injected_delay(0.0) == 0.0
+    for _ in range(50):
+        d = srv._injected_delay(0.05)
+        assert 0.05 <= d <= 0.25
+    srv.set_faults(jitter_s=0.0)
+    assert srv._injected_delay(0.05) == 0.05
+    # unknown knobs are typos, not silent no-ops
+    try:
+        srv.set_faults(first_bite_delay_s=1.0)
+        raise AssertionError("unknown fault knob accepted")
+    except AttributeError:
+        pass

@@ -123,5 +123,5 @@ def test_ci_gate_composes_stages():
     assert summary["gate"] == "ok"
     assert [s["stage"] for s in summary["stages"]] == [
         "lint-envvars", "lint-metrics", "lint-events", "validate-manifests",
-        "chaos-check", "structured-check"]
+        "chaos-check", "structured-check", "slo-check"]
     assert all(s["ok"] for s in summary["stages"])

@@ -60,6 +60,9 @@ def main() -> int:
         # structured outputs: constrained generations must conform 100% and
         # malformed schemas must 400 before admission
         ("structured-check", [py, "tools/structured_check.py"], CPU_ENV),
+        # closed autoscaling loop: 10x swing + replica kill/flap mid-burst,
+        # SLO attainment >= 95%, zero 5xx, back to floor, warm 0->1 < cold
+        ("slo-check", [py, "tools/slo_check.py"], CPU_ENV),
     ]
     if not args.skip_tests:
         pytest_cmd = [py, "-m", "pytest", "tests/", "-q"]

@@ -45,6 +45,7 @@ def registry_families() -> set[str]:
             Summary,
             register_engine_metrics,
             register_engine_server_metrics,
+            register_pool_metrics,
             register_router_metrics,
         )
     finally:
@@ -54,6 +55,7 @@ def registry_families() -> set[str]:
     register_engine_metrics(reg)
     register_engine_server_metrics(reg)
     register_router_metrics(reg)
+    register_pool_metrics(reg)
     names: set[str] = set()
     for name in reg.families():
         names.add(name)
