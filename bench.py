@@ -142,6 +142,18 @@ def main() -> None:
                          "f=Dhp/head_dim real KV heads per 128-lane row on "
                          "eligible models (llama-1b: f=2, halves KV bytes "
                          "again); padded forces one head per row (A/B)")
+    ap.add_argument("--tune-attn", action="store_true",
+                    help="force the attention block-size auto-tuner even off-"
+                         "TPU (interpreter/XLA timings are meaningless there, "
+                         "but the candidate sweep, tune-file merge, and engine "
+                         "load path are the real code — ci_gate's "
+                         "bench-tiny-attn stage pins the round trip) and "
+                         "assert the engine loaded the exported table")
+    ap.add_argument("--attn-tune-file", default=None,
+                    help="tune-table path (ops/attn_tune JSON) the tuner "
+                         "merges winners into; default: LLMD_ATTN_TUNE_FILE, "
+                         "else attn_tune.json next to bench.py (a temp file "
+                         "under --tune-attn so CI runs don't pollute the tree)")
     ap.add_argument("--spec-mode", default="off", choices=["off", "ngram"],
                     help="speculative decoding: ngram = prompt-lookup drafts "
                          "verified through the mixed-batch step (one verify "
@@ -368,7 +380,8 @@ def main() -> None:
               f"(NT={run_cfg.batched_tokens}, k={run_cfg.decode_steps})",
               file=sys.stderr)
         print(f"# attn_backend={eng.attn_backend}"
-              + (f" (fallback: {eng.attn_fallback_reason})" if eng.attn_fallback_reason else ""),
+              + (f" (fallback: {eng.attn_fallback_reason})" if eng.attn_fallback_reason else "")
+              + (f" tune={eng.attn_tune_hash}" if eng.attn_tune_hash else ""),
               file=sys.stderr)
         print(f"# moe_backend={eng.moe_backend}", file=sys.stderr)
         t0 = time.monotonic()
@@ -378,69 +391,116 @@ def main() -> None:
         from llmd_tpu.engine.engine import EngineStats
 
         eng.stats = EngineStats(attn_backend=eng.stats.attn_backend,
-                                moe_backend=eng.stats.moe_backend)
+                                attn_tune_hash=eng.stats.attn_tune_hash,
+                                moe_backend=eng.stats.moe_backend,
+                                kv_cache_dtype=eng.stats.kv_cache_dtype,
+                                kv_layout=eng.stats.kv_layout)
         t0 = time.monotonic()
         out = eng.generate(prompts(n_req, salt=2), sp)
         return eng, out, time.monotonic() - t0
 
-    def tune_attention() -> None:
-        """On-chip: time candidate Pallas attention block sizes at the decode
-        shape and export the winner via LLMD_ATTN_BKV/BQ. Kernel ablation
-        showed attention at 4.4 ms/step vs a ~0.9 ms KV-read roofline — the
-        single largest per-step cost — and the default (bkv=8, bq=32) was
+    def tune_attention() -> "str | None":
+        """Time candidate attention block sizes at the decode shape and export
+        the winner two ways: the legacy LLMD_ATTN_BKV/BQ env override and a
+        shape-keyed entry merged into the tune table (ops/attn_tune), which
+        the engine loads via LLMD_ATTN_TUNE_FILE — so a campaign accumulates
+        per-(batch, page_size, head layout) winners instead of one global
+        answer tuned at whatever batch ran last. Kernel ablation showed
+        attention at 4.4 ms/step vs a ~0.9 ms KV-read roofline — the single
+        largest per-step cost — and the original default (bkv=8, bq=32) was
         chosen with broken timing (block_until_ready is a no-op through the
-        tunnel). Wholly best-effort: any failure keeps the defaults."""
-        if jax.default_backend() != "tpu":
-            return
+        tunnel). Returns the merged table's hash, or None if nothing ran.
+
+        Candidates route through the REAL serving impl (paged_attention_tpu,
+        packed-wrapped when serving packs) with the candidate applied via the
+        env overrides and a fresh trace per candidate — the measurement
+        includes the adapter and slot-placement overheads serving pays.
+        Off-TPU (--tune-attn only) the impl is the XLA reference: timings are
+        meaningless there (block sizes never reach the XLA path) but the
+        sweep, tune-file merge, env export, and engine load are the same
+        code — ci_gate's bench-tiny-attn stage pins that round trip on CPU.
+        Wholly best-effort on-chip: any failure keeps the defaults."""
+        on_tpu = jax.default_backend() == "tpu"
+        if not on_tpu and not args.tune_attn:
+            return None
+        if cfg.is_mla:
+            # the latent decode kernel (ops/mla_decode) streams one page per
+            # grid step — it has no block-size knobs to tune
+            print("# attn-tune: MLA latent decode has no block-size knobs; "
+                  "skipping", file=sys.stderr)
+            return None
         import numpy as _np
 
-        from llmd_tpu.ops.paged_attention import VMEM_LIMIT, _kernel
-
-        from llmd_tpu.models.transformer import padded_head_dim
+        from llmd_tpu.models.transformer import (
+            padded_head_dim, ragged_paged_attention_xla)
+        from llmd_tpu.ops import attn_tune as _attn_tune
 
         B = eng_cfg.max_batch_size
         ps = eng_cfg.page_size
         kvlen = isl + osl // 2
         maxp = (isl + osl + eng_cfg.decode_steps * 3) // ps + 1
-        npages = max(1024, B * maxp)
+        npages = max(1024, B * maxp) if on_tpu else B * maxp + 8
         Hk = max(1, cfg.num_kv_heads)
         Dhp = padded_head_dim(cfg.head_dim)
-        cache = jnp.zeros((npages, ps, 2 * Hk, Dhp), jnp.bfloat16)
+        pack = 1
+        if eng_cfg.kv_layout != "padded":
+            from llmd_tpu.ops.packed_kv import pack_factor
+            pack = pack_factor(cfg)
+        planes = 2 * Hk // pack
+        cache = jnp.zeros((npages, ps, planes, Dhp), jnp.bfloat16)
         pts = _np.zeros((B, maxp), _np.int32)
         for i in range(B):
             pts[i] = (_np.arange(i * maxp, (i + 1) * maxp)) % npages
         pts = jnp.asarray(pts)
         kv_lens = jnp.full((B,), kvlen, jnp.int32)
+        pos0 = jnp.full((B,), kvlen - 1, jnp.int32)
+        slots0 = jnp.arange(B, dtype=jnp.int32)
         cu = jnp.asarray(_np.arange(B + 1), jnp.int32)
         ns = jnp.asarray([B], jnp.int32)
         q0 = jnp.ones((B, cfg.num_heads, Dhp), jnp.bfloat16)
-        rpa = _kernel()
+        if on_tpu:
+            from llmd_tpu.ops.paged_attention import paged_attention_tpu
+            impl = paged_attention_tpu
+        else:
+            impl = ragged_paged_attention_xla
+        if pack > 1:
+            from llmd_tpu.ops.packed_kv import make_packed_attn
+            impl = make_packed_attn(impl, cfg, pack)
+        scan_len = 16 if on_tpu else 2
+        _ENV = ("LLMD_ATTN_BKV", "LLMD_ATTN_BQ", "LLMD_ATTN_DECODE_N")
 
         def timed(bkv: int, bq: int) -> float:
             import jax.lax as lax
-
-            def f(q):
-                def body(qq, _):
-                    o = rpa(qq, cache, kv_lens, pts, cu, ns, sm_scale=0.125,
-                            num_kv_pages_per_block=bkv, num_queries_per_block=bq,
-                            vmem_limit_bytes=VMEM_LIMIT)
-                    return (o * 1e-3 + qq * 0.999).astype(qq.dtype), None
-                qq, _ = lax.scan(body, q, None, length=16)
-                return jnp.sum(qq.astype(jnp.float32))
-            jf = jax.jit(f)
-            _np.asarray(jax.device_get(jf(q0)))  # compile + settle
-            # FRESH input per measured call: the tunneled runtime
-            # content-caches identical (executable, args) pairs — re-timing q0
-            # would measure the cache, not the kernel. Multipliers must be
-            # EXACTLY representable in bf16 (1.001 rounds to 1.0 — spacing near
-            # 1.0 is 1/128 — which would reproduce q0 bitwise and hit the
-            # cache). min-of-2 damps per-dispatch RTT jitter.
-            times = []
-            for rep in (1.0078125, 1.015625):  # 1+1/128, 1+2/128: exact in bf16
-                t0 = time.monotonic()
-                _np.asarray(jax.device_get(jf(q0 * jnp.bfloat16(rep))))
-                times.append(time.monotonic() - t0)
-            return min(times)
+            saved = {k: os.environ.get(k) for k in _ENV}
+            os.environ.update(LLMD_ATTN_BKV=str(bkv), LLMD_ATTN_BQ=str(bq),
+                              LLMD_ATTN_DECODE_N=str(B))
+            try:
+                def f(q):
+                    def body(qq, _):
+                        o = impl(qq, cache, pts, pos0, slots0, kv_lens,
+                                 scale=0.125, cu_q_lens=cu, num_seqs=ns)
+                        return (o * 1e-3 + qq * 0.999).astype(qq.dtype), None
+                    qq, _ = lax.scan(body, q, None, length=scan_len)
+                    return jnp.sum(qq.astype(jnp.float32))
+                # fresh closure => fresh trace per candidate: the env override
+                # is read at trace time inside pick_block_sizes
+                jf = jax.jit(f)
+                _np.asarray(jax.device_get(jf(q0)))  # compile + settle
+                # FRESH input per measured call: the tunneled runtime
+                # content-caches identical (executable, args) pairs — re-timing
+                # q0 would measure the cache, not the kernel. Multipliers must
+                # be EXACTLY representable in bf16 (1.001 rounds to 1.0 —
+                # spacing near 1.0 is 1/128 — which would reproduce q0 bitwise
+                # and hit the cache). min-of-2 damps per-dispatch RTT jitter.
+                times = []
+                for rep in (1.0078125, 1.015625):  # 1+1/128, 1+2/128: exact in bf16
+                    t0 = time.monotonic()
+                    _np.asarray(jax.device_get(jf(q0 * jnp.bfloat16(rep))))
+                    times.append(time.monotonic() - t0)
+                return min(times)
+            finally:
+                for k, v in saved.items():
+                    os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
 
         candidates = [(8, 32), (max(1, maxp // 2), 32), (maxp, 32), (8, 16)]
         default = candidates[0]
@@ -449,27 +509,58 @@ def main() -> None:
             try:
                 results[(bkv, bq)] = timed(bkv, bq)
                 print(f"# attn-tune bkv={bkv} bq={bq}: "
-                      f"{results[(bkv, bq)]*1e3:.1f} ms/16calls", file=sys.stderr)
+                      f"{results[(bkv, bq)]*1e3:.1f} ms/{scan_len}calls",
+                      file=sys.stderr)
             except Exception:
                 continue
-        if default in results and results:
-            best = min(results, key=results.get)
-            # a non-default winner must beat the default by a real margin —
-            # residual RTT jitter must not flip the policy
-            if best != default and results[best] < 0.95 * results[default]:
-                os.environ["LLMD_ATTN_BKV"] = str(best[0])
-                os.environ["LLMD_ATTN_BQ"] = str(best[1])
-                # gate tracks the exact batch the candidates were timed at —
-                # without it a --batch 256 run would tune, export, and then
-                # silently never apply the overrides (default gate is 128)
-                os.environ["LLMD_ATTN_DECODE_N"] = str(B)
-                print(f"# attn-tune picked bkv={best[0]} bq={best[1]} "
-                      f"(decode_n={B})", file=sys.stderr)
+        if default not in results or not results:
+            return None
+        best = min(results, key=results.get)
+        # a non-default winner must beat the default by a real margin —
+        # residual RTT jitter must not flip the policy
+        if best != default and results[best] >= 0.95 * results[default]:
+            best = default
+        if best != default:
+            os.environ["LLMD_ATTN_BKV"] = str(best[0])
+            os.environ["LLMD_ATTN_BQ"] = str(best[1])
+            # gate tracks the exact batch the candidates were timed at —
+            # without it a --batch 256 run would tune, export, and then
+            # silently never apply the overrides (default gate is 128)
+            os.environ["LLMD_ATTN_DECODE_N"] = str(B)
+            print(f"# attn-tune picked bkv={best[0]} bq={best[1]} "
+                  f"(decode_n={B})", file=sys.stderr)
+        # the winner ALWAYS lands in the table (even when it is the default:
+        # a timed win at this shape beats re-deriving the heuristic later)
+        path = args.attn_tune_file or os.environ.get("LLMD_ATTN_TUNE_FILE")
+        if not path:
+            here = os.path.dirname(os.path.abspath(__file__))
+            if args.tune_attn:
+                import tempfile
+                path = os.path.join(tempfile.gettempdir(),
+                                    f"llmd_attn_tune_{os.getpid()}.json")
+            else:
+                path = os.path.join(here, "attn_tune.json")
+        entry = {
+            "batch": B, "page_size": ps, "pages_per_seq": maxp,
+            "head_layout": _attn_tune.head_layout_key(cfg.num_heads, Dhp, planes),
+            "bkv": best[0], "bq": best[1],
+            "us_per_call": round(results[best] / scan_len * 1e6, 1),
+            "tuned_on": getattr(jax.devices()[0], "device_kind",
+                                jax.default_backend()),
+        }
+        table = _attn_tune.merge_and_save(path, [entry])
+        os.environ["LLMD_ATTN_TUNE_FILE"] = path
+        print(f"# attn-tune table {path} sha={table.sha} "
+              f"({len(table.entries)} entries)", file=sys.stderr)
+        return table.sha
 
-    if not tiny:
+    attn_tune_sha = None
+    if not tiny or args.tune_attn:
         try:
-            tune_attention()
+            attn_tune_sha = tune_attention()
         except Exception as e:  # tuning must never cost the bench run
+            if args.tune_attn:
+                raise  # ...except when the round trip IS the point (ci_gate)
             print(f"# attn-tune skipped ({type(e).__name__}: {e})", file=sys.stderr)
 
     primary_error = None
@@ -505,6 +596,15 @@ def main() -> None:
         n_req = min(n_req, 32)
         eng, out, wall = build_and_measure(eng_cfg)
     dev = jax.devices()[0]
+    if args.tune_attn and attn_tune_sha is not None:
+        # the round-trip gate: the engine must have loaded the exact table the
+        # tuner just exported (same short hash) — a silent miss here is the
+        # "tuned but never applied" failure mode this machinery replaces
+        assert eng.attn_tune_hash == attn_tune_sha, (
+            "engine did not load the tuner's exported table",
+            eng.attn_tune_hash, attn_tune_sha)
+        print(f"# attn-tune round trip OK (engine loaded sha={attn_tune_sha})",
+              file=sys.stderr)
     out_tokens = sum(len(v) for v in out.values())
     assert out_tokens == n_req * osl, (out_tokens, n_req * osl)
     tput = out_tokens / wall
@@ -578,6 +678,7 @@ def main() -> None:
         "kv_layout": eng.stats.kv_layout,
         "attn_backend": eng.attn_backend,
         "attn_fallback_reason": eng.attn_fallback_reason,
+        "attn_tune_hash": eng.attn_tune_hash,
         "moe_backend": eng.moe_backend,
         "device": getattr(dev, "device_kind", str(dev)),
         "weights_bw_gbs": round(achieved_gbs, 1),

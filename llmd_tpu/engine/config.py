@@ -75,7 +75,16 @@ class EngineConfig:
     # Attention kernel: "auto" = Pallas ragged-paged-attention on TPU / XLA
     # reference semantics elsewhere, "pallas" = force the Pallas kernel,
     # "reference" = gather+mask (models.transformer.ragged_paged_attention_xla).
+    # MLA models: the mixed-batch programs always run the absorbed XLA impl;
+    # the fused-decode program takes the latent-width Pallas kernel
+    # (ops/mla_decode) on TPU under "auto", anywhere under "pallas".
     attn_impl: str = "auto"
+    # Attention block-size auto-tune table (ops/attn_tune): path to the JSON
+    # cache bench.py's on-chip tuner exports; pick_block_sizes consults it per
+    # (batch, page_size, head layout) before its heuristic. None = resolve
+    # LLMD_ATTN_TUNE_FILE from the environment (missing/corrupt files degrade
+    # to the heuristic with a warning, never a startup failure).
+    attn_tune_file: "str | None" = None
     # Long-context sequence parallelism: when mesh.sp > 1, serve self-contained
     # single-sequence prefill steps through the zig-zag ring-attention program
     # (ops/ring_attention.py) instead of GSPMD-annotated paged attention. The

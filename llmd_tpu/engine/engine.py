@@ -89,6 +89,7 @@ class EngineStats:
     total_offload_loads: int = 0  # blocks pulled back from CPU/FS tiers
     eplb_rebalances: int = 0  # wide-EP expert-placement recomputes
     attn_backend: str = ""  # kernel provenance (bench/debug)
+    attn_tune_hash: Optional[str] = None  # active block-size tune table (ops/attn_tune)
     moe_backend: str = ""
     kv_cache_dtype: str = ""  # "bf16" | "fp8" — pool dtype provenance
     kv_layout: str = ""  # "padded" | "packed-f" — pool lane layout provenance
@@ -328,6 +329,16 @@ class LLMEngine:
 
         cfg = model_cfg
         mesh = self.mesh
+        # shape-keyed attention block-size tune table (bench.py's auto-tuner
+        # export, ops/attn_tune): an explicit config path pins the table;
+        # otherwise LLMD_ATTN_TUNE_FILE resolves lazily inside
+        # pick_block_sizes. The short hash rides provenance (stats/bench JSON)
+        # so every measured number traces to the table that shaped its kernels.
+        from llmd_tpu.ops import attn_tune
+
+        if engine_cfg.attn_tune_file:
+            attn_tune.activate(attn_tune.load_table(engine_cfg.attn_tune_file))
+        self.attn_tune_hash = attn_tune.active_hash()
         attn = self._select_attn_impl()
         if self.kv_pack > 1:
             from llmd_tpu.ops.packed_kv import make_packed_attn
@@ -337,9 +348,16 @@ class LLMEngine:
             # attends over chunk activations, not the pool)
             attn = make_packed_attn(attn, model_cfg, self.kv_pack)
             self.attn_backend += f"+packed{self.kv_pack}"
+        attn_decode = self._select_decode_attn_impl(attn)
         moe_impl = self._select_moe_impl()
         self.stats.attn_backend = self.attn_backend
+        self.stats.attn_tune_hash = self.attn_tune_hash
         self.stats.moe_backend = self.moe_backend
+        # kernel-vs-fallback visibility without scraping logs: an info-style
+        # gauge keyed by the resolved backend + tune-table hash (value 1)
+        self.metrics.attn_backend_info.labels(
+            backend=self.attn_backend,
+            tune=self.attn_tune_hash or "none").set(1)
         self.stats.kv_cache_dtype = ("fp8" if self.kv_dtype == jnp.float8_e4m3fn
                                      else str(jnp.dtype(self.kv_dtype).name))
         self.stats.kv_layout = (f"packed-{self.kv_pack}" if self.kv_pack > 1
@@ -430,7 +448,7 @@ class LLMEngine:
                 cache, toks, pos, lens, key = carry
                 hidden, cache, cnt = forward_core(
                     cfg, params, cache, toks, pos, seq_slots, page_tables, lens,
-                    cu_q_lens=cu, num_seqs=ns, attn_impl=attn,
+                    cu_q_lens=cu, num_seqs=ns, attn_impl=attn_decode,
                     moe_matmul_impl=moe_impl,
                     lora_indices=lora_idx if use_lora else None,
                     lora_scale=lora_scale,
@@ -474,6 +492,31 @@ class LLMEngine:
         self._verify_fn = jax.jit(_make_verify(attn), **donate)
         self._decode_multi_fn = jax.jit(_decode_multi, **donate)
         self._embed_fn = jax.jit(_embed, **donate)
+
+        # "attn" step-phase probe: a jitted attention-ONLY call at the live
+        # decode shape (real pool, layer-0 page tables), run every
+        # _attn_probe_every fused dispatches and observed into
+        # step_duration{phase="attn"} scaled by layers x k — an estimate of
+        # the fused call's attention share, directly comparable against the
+        # decode_dispatch samples (PERF.md roofline reconciliation). Sampled
+        # because a per-step device sync would serialize the pipelined
+        # dispatch path it is trying to measure.
+        dhp_kv = self.cache.shape[-1]
+        attn_probe_scale = ((cfg.mla_qk_nope_dim + cfg.mla_rope_dim) ** -0.5
+                            if cfg.is_mla else cfg.head_dim ** -0.5)
+
+        def _attn_probe(cache, page_tables, kv_lens):
+            q = jnp.zeros((B, cfg.num_heads, dhp_kv), cfg.jax_dtype)
+            return attn_decode(
+                q, cache, page_tables, kv_lens - 1,
+                jnp.arange(B, dtype=jnp.int32), kv_lens,
+                scale=attn_probe_scale,
+                cu_q_lens=jnp.arange(B + 1, dtype=jnp.int32),
+                num_seqs=jnp.array([B], jnp.int32))
+
+        self._attn_probe_fn = jax.jit(_attn_probe)
+        self._attn_probe_every = 64
+        self._attn_probe_warm = False
         # SP long-context prefill: a second unified program whose attention is
         # the zig-zag ring over the sp axis (ops/ring_attention.py), engaged
         # host-side for self-contained single-sequence prefill steps only —
@@ -508,18 +551,13 @@ class LLMEngine:
         mode = self.cfg.attn_impl
         if self.model_cfg.is_mla:
             # Absorbed MLA runs as MQA with head_dim = latent rank + rope dim
-            # (typically 288–640 lanes) — past the Pallas kernel's supported
-            # head sizes; the XLA impl handles it at any width. The absorbed
-            # math itself is the win: per-token KV is ~4–8x smaller, so the
-            # gather the XLA path pays streams proportionally fewer bytes.
-            if mode == "pallas":
-                # explicit 'pallas' is a hard guarantee everywhere else —
-                # honor the contract rather than silently downgrading
-                raise ValueError(
-                    "attn_impl='pallas' cannot serve MLA models (latent "
-                    "head_dim exceeds the kernel's head sizes); use 'auto'")
-            # xla_mla_absorbed is the DESIGNED backend for MLA, not a
-            # degradation — provenance lives in attn_backend alone so
+            # (typically 288–640 lanes) — past the GQA Pallas kernel's
+            # supported head sizes; the XLA impl handles the mixed-batch
+            # programs (unified/verify/embed) at any width. The fused-decode
+            # program upgrades to the latent-width Pallas kernel in
+            # _select_decode_attn_impl — decode is where the KV stream lives.
+            # xla_mla_absorbed is the DESIGNED mixed-batch backend for MLA,
+            # not a degradation — provenance lives in attn_backend alone so
             # fallback alerts stay quiet on healthy MLA engines
             self.attn_backend = "xla_mla_absorbed"
             return ragged_paged_attention_xla
@@ -563,6 +601,57 @@ class LLMEngine:
             self.attn_backend = "xla_reference"
             self.attn_fallback_reason = f"pallas smoke-compile failed: {type(e).__name__}: {e}"
             return ragged_paged_attention_xla
+
+    def _select_decode_attn_impl(self, unified_attn):
+        """Attention impl for the FUSED-DECODE program only.
+
+        GQA engines share the unified impl (the ragged Pallas kernel already
+        serves mixed batches). MLA engines upgrade to the latent-width Pallas
+        decode kernel (`ops.mla_decode`): the fused-decode batch is exactly
+        its shape — one query row per slot over the single-plane latent pool —
+        while unified/verify/embed (mixed chunk shapes) keep the XLA absorbed
+        reference. On success ``attn_backend`` becomes
+        ``pallas_mla_latent_decode`` and ``attn_fallback_reason`` stays None.
+
+        `attn_impl` semantics on MLA: "auto" takes the kernel on TPU only
+        (interpreter-mode Pallas is orders of magnitude slower than the XLA
+        reference on CPU meshes); explicit "pallas" forces it anywhere —
+        interpret mode off-TPU — and raises on smoke-compile failure, the
+        same hard guarantee the explicit mode carries for GQA; "reference"
+        keeps the XLA impl everywhere.
+        """
+        if not self.model_cfg.is_mla:
+            return unified_attn
+        mode = self.cfg.attn_impl
+        if mode == "reference":
+            return unified_attn
+        if mode == "auto" and jax.default_backend() != "tpu":
+            return unified_attn
+        from llmd_tpu.ops.mla_decode import mla_paged_attention_latent
+
+        try:  # smoke-compile tiny decode shapes so a Mosaic failure can't strand serving
+            c = self.model_cfg
+            dhp = self.cache.shape[-1]  # padded latent width == pool lane width
+            ps = self.cfg.page_size
+            q = jnp.zeros((1, c.num_heads, dhp), c.jax_dtype)
+            cache = jnp.zeros((2, ps, 1, dhp), self.kv_dtype)
+            mla_paged_attention_latent(
+                q, cache, jnp.zeros((1, 2), jnp.int32),
+                jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+                jnp.ones((1,), jnp.int32),
+                scale=(c.mla_qk_nope_dim + c.mla_rope_dim) ** -0.5,
+                cu_q_lens=jnp.array([0, 1], jnp.int32),
+                num_seqs=jnp.array([1], jnp.int32),
+            ).block_until_ready()
+            self.attn_backend = "pallas_mla_latent_decode"
+            self.attn_fallback_reason = None
+            return mla_paged_attention_latent
+        except Exception as e:  # noqa: BLE001 — any Mosaic/XLA compile error
+            if mode == "pallas":
+                raise
+            self.attn_fallback_reason = (
+                f"mla latent decode smoke-compile failed: {type(e).__name__}: {e}")
+            return unified_attn
 
     def _select_moe_impl(self):
         """Pick the MoE expert-GEMM path: Pallas grouped GEMM on TPU (after a smoke
@@ -1798,6 +1887,12 @@ class LLMEngine:
         self.metrics.step_duration.labels(phase="decode_dispatch").observe(
             time.perf_counter() - wall_start,
             exemplar=self._trace_exemplar(active))
+        # first probe at dispatch _attn_probe_every, not 1: serving engines
+        # reach it in seconds, while short-lived engines (tests, tiny bench)
+        # never pay the probe's one-off compile
+        if (self._attn_probe_fn is not None
+                and self.stats.n_decode_dispatches % self._attn_probe_every == 0):
+            self._observe_attn_phase(pts, lens, k)
         # Start the device->host copy of everything _decode_process will read.
         # Remote/tunneled runtimes defer execution until a result is demanded;
         # the async-copy hint makes the call run (and its tokens land on the
@@ -1812,6 +1907,26 @@ class LLMEngine:
             "rows": [(s, s.slot) for s in active],
             "toks_out": toks_out, "last_toks": last_toks, "cnt": cnt, "k": k,
         }
+
+    def _observe_attn_phase(self, pts: np.ndarray, lens: np.ndarray, k: int) -> None:
+        """Sampled attention-share probe: time one attention-only jitted call at
+        the shapes the dispatch just ran, observe wall x layers x k as the
+        estimated attention share of a fused decode call. The first invocation
+        compiles and is discarded (a compile sample would dominate the
+        histogram); a probe failure disables further probes rather than
+        degrading serving — the step itself already ran."""
+        try:
+            args = (self.cache, jnp.asarray(pts), jnp.asarray(lens))
+            if not self._attn_probe_warm:
+                self._attn_probe_fn(*args).block_until_ready()
+                self._attn_probe_warm = True
+            t0 = time.perf_counter()
+            self._attn_probe_fn(*args).block_until_ready()
+            dt = time.perf_counter() - t0
+            self.metrics.step_duration.labels(phase="attn").observe(
+                dt * self.model_cfg.num_layers * k)
+        except Exception:  # noqa: BLE001 — observability must not take down serving
+            self._attn_probe_fn = None
 
     def _decode_process(self, rec: dict) -> None:
         """Read one in-flight decode call's results and apply them to host state."""

@@ -52,6 +52,15 @@ def main() -> None:
                     choices=["auto", "packed", "padded"],
                     help="KV pool lane layout (ops/packed_kv): auto packs "
                          "head_dim-64 models' KV pairs per 128-lane row")
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=["auto", "pallas", "reference"],
+                    help="attention kernel selection (EngineConfig.attn_impl);"
+                         " MLA decode takes the latent Pallas kernel on TPU "
+                         "under auto, anywhere under pallas")
+    ap.add_argument("--attn-tune-file",
+                    default=os.environ.get("LLMD_ATTN_TUNE_FILE"),
+                    help="shape-keyed attention block-size table "
+                         "(ops/attn_tune JSON, written by bench.py's tuner)")
     ap.add_argument("--cpu-offload-pages", type=int, default=0,
                     help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
     ap.add_argument("--offload-fs-path", default=None,
@@ -129,6 +138,8 @@ def main() -> None:
         quantize_weights=args.quantize,
         kv_cache_dtype=args.kv_cache_dtype,
         kv_layout=args.kv_layout,
+        attn_impl=args.attn_impl,
+        attn_tune_file=args.attn_tune_file,
         spec_mode=args.spec_mode, spec_tokens=args.spec_tokens,
         spec_ngram_max=args.spec_ngram_max, spec_ngram_min=args.spec_ngram_min,
         structured_mode=args.structured_mode,

@@ -430,8 +430,15 @@ class EngineMetrics:
         self.step_duration = reg.histogram(
             "llmd_tpu:engine_step_duration_seconds",
             "Engine step wall time by phase "
-            "(unified, decode_dispatch, decode_process, spec_verify)",
+            "(unified, decode_dispatch, decode_process, spec_verify; attn = "
+            "sampled attention-only probe scaled to the fused call: "
+            "wall x layers x k)",
             labelnames=("phase",))
+        self.attn_backend_info = reg.gauge(
+            "llmd_tpu:engine_attn_backend",
+            "Resolved attention backend + active block-size tune-table hash "
+            "(info-style: value 1 on the selected label set)",
+            labelnames=("backend", "tune"))
         self.batch_occupancy = reg.histogram(
             "llmd_tpu:engine_batch_occupancy",
             "Running/waiting sequence counts sampled once per engine step",

@@ -1,0 +1,204 @@
+"""Shape-keyed attention block-size auto-tune table.
+
+`pick_block_sizes` started life as a static heuristic swept once on v5e at
+llama-1b/B=32 shapes, later patched with `LLMD_ATTN_BKV`/`LLMD_ATTN_BQ` env
+overrides written by bench.py's on-chip tuner. Both share a flaw: one global
+answer. The optimum moves with (batch, pages_per_seq, head layout) — the env
+override tuned at b32 is exactly the "block sizes chosen for batch-32" running
+at batch-128 that the r05 campaign exposed (PERF.md Round 6).
+
+This module replaces the single-winner scheme with a persistent, shape-keyed
+table:
+
+- bench.py's auto-tuner times candidates at each serving shape it visits and
+  **merges** winners into a JSON cache file (one entry per shape key, newest
+  wins), so a campaign accumulates a per-chip table across points,
+- the engine loads the file at startup (`EngineConfig.attn_tune_file` or
+  ``LLMD_ATTN_TUNE_FILE``) and `pick_block_sizes` consults it before the
+  heuristic; the env overrides still win over both (operator escape hatch),
+- provenance: `table_hash()` is reported by engine stats and bench JSON so a
+  measured number can be traced to the exact table that shaped its kernels.
+
+File format (version 1)::
+
+    {"version": 1,
+     "entries": [{"batch": 64, "page_size": 64, "pages_per_seq": 8,
+                  "head_layout": "h16x128kv8", "bkv": 2, "bq": 32,
+                  "us_per_call": 123.4, "tuned_on": "TPU v5e"}, ...]}
+
+Lookup requires an exact (batch, page_size, head_layout) match — block sizes
+tuned for one head geometry or page size say nothing about another — and takes
+the entry with the **nearest pages_per_seq** (tables grow with max_model_len;
+a b128 entry tuned at 8 pages/seq is still the best available answer at 10).
+
+A missing, unreadable, or corrupt file degrades to the heuristic with a
+warning — never an engine-startup failure. Malformed entries are dropped
+individually so one bad merge doesn't void a whole campaign's table.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+from dataclasses import dataclass, field
+
+log = logging.getLogger("llmd_tpu.attn_tune")
+
+ENV_TUNE_FILE = "LLMD_ATTN_TUNE_FILE"
+
+_REQUIRED_INT_FIELDS = ("batch", "page_size", "pages_per_seq", "bkv", "bq")
+
+
+def head_layout_key(num_q_heads: int, head_dim_padded: int, kv_planes: int) -> str:
+    """Canonical head-layout key: query heads x padded head width, KV planes
+    per token (2*Hk for the combined GQA layout, 1 for the MLA latent plane,
+    2*Hk/kv_pack when slot-packed)."""
+    return f"h{num_q_heads}x{head_dim_padded}kv{kv_planes}"
+
+
+@dataclass(frozen=True)
+class AttnTuneTable:
+    """Validated, immutable view of a tune file."""
+
+    entries: tuple = ()
+    source: str = ""
+    sha: str = ""  # short content hash of the *valid* entries, for provenance
+    dropped: int = 0  # malformed entries discarded at load
+
+    def lookup(self, batch: int, page_size: int, pages_per_seq: int,
+               head_layout: "str | None") -> "tuple[int, int] | None":
+        best = None
+        for e in self.entries:
+            if e["batch"] != batch or e["page_size"] != page_size:
+                continue
+            if head_layout is not None and e["head_layout"] != head_layout:
+                continue
+            d = abs(e["pages_per_seq"] - pages_per_seq)
+            if best is None or d < best[0]:
+                best = (d, e)
+        if best is None:
+            return None
+        e = best[1]
+        # clamp like the env path: a table tuned at more pages/seq than this
+        # engine allocates must not index past the sequence page budget
+        return (max(1, min(pages_per_seq, int(e["bkv"]))), max(1, int(e["bq"])))
+
+
+def _validate_entry(e) -> "dict | None":
+    if not isinstance(e, dict):
+        return None
+    out = {}
+    for k in _REQUIRED_INT_FIELDS:
+        v = e.get(k)
+        if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+            return None
+        out[k] = v
+    hl = e.get("head_layout")
+    if not isinstance(hl, str) or not hl:
+        return None
+    out["head_layout"] = hl
+    # carry optional provenance fields through merges untouched
+    for k in ("us_per_call", "tuned_on", "tuned_at"):
+        if k in e:
+            out[k] = e[k]
+    return out
+
+
+def entries_hash(entries) -> str:
+    """Order-independent short hash over the shape→winner mapping (provenance
+    fields included so a re-tune with identical winners still changes hash)."""
+    canon = sorted(json.dumps(e, sort_keys=True) for e in entries)
+    return hashlib.sha256("\n".join(canon).encode()).hexdigest()[:12]
+
+
+def load_table(path: str) -> "AttnTuneTable | None":
+    """Parse + validate a tune file. Returns None (with a warning) on any
+    file-level problem; drops malformed entries individually."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except FileNotFoundError:
+        log.warning("attn tune file %s not found; using block-size heuristic", path)
+        return None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        log.warning("attn tune file %s unreadable (%s); using block-size "
+                    "heuristic", path, e)
+        return None
+    if not isinstance(raw, dict) or raw.get("version") != 1 \
+            or not isinstance(raw.get("entries"), list):
+        log.warning("attn tune file %s has unknown schema; using block-size "
+                    "heuristic", path)
+        return None
+    valid, dropped = [], 0
+    for e in raw["entries"]:
+        v = _validate_entry(e)
+        if v is None:
+            dropped += 1
+        else:
+            valid.append(v)
+    if dropped:
+        log.warning("attn tune file %s: dropped %d malformed entries", path, dropped)
+    return AttnTuneTable(entries=tuple(valid), source=path,
+                         sha=entries_hash(valid), dropped=dropped)
+
+
+def merge_and_save(path: str, new_entries) -> AttnTuneTable:
+    """bench.py's export: merge winners into an existing table file (same
+    shape key → newest wins) and write it back atomically. Returns the merged
+    table so the caller can report its hash."""
+    existing = load_table(path) if os.path.exists(path) else None
+    def key(e):
+        return (e["batch"], e["page_size"], e["pages_per_seq"], e["head_layout"])
+    merged = {key(e): e for e in (existing.entries if existing else ())}
+    for e in new_entries:
+        v = _validate_entry(e)
+        if v is None:
+            raise ValueError(f"refusing to write malformed tune entry: {e!r}")
+        merged[key(v)] = v
+    entries = [merged[k] for k in sorted(merged)]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return AttnTuneTable(entries=tuple(entries), source=path,
+                         sha=entries_hash(entries))
+
+
+# ---------------------------------------------------------------------------
+# process-wide active table, consulted by pick_block_sizes
+# ---------------------------------------------------------------------------
+_active: "AttnTuneTable | None" = None
+_pinned = False  # explicit activate() beats env resolution
+_resolved_env_path: "str | None" = None  # last env path resolved (cache key)
+
+
+def activate(table: "AttnTuneTable | None") -> None:
+    """Pin a table (engine startup with an explicit `attn_tune_file`).
+    activate(None) unpins and returns control to env-var resolution."""
+    global _active, _pinned, _resolved_env_path
+    _active = table
+    _pinned = table is not None
+    _resolved_env_path = object()  # force re-resolution once unpinned
+
+
+def active_table() -> "AttnTuneTable | None":
+    """The table pick_block_sizes consults. An explicitly activate()d table
+    wins; otherwise ``LLMD_ATTN_TUNE_FILE`` is resolved lazily and re-resolved
+    whenever the env var changes (tests and the bench tuner set it
+    mid-process)."""
+    global _active, _resolved_env_path
+    if _pinned:
+        return _active
+    env_path = os.environ.get(ENV_TUNE_FILE) or None
+    if env_path != _resolved_env_path:
+        _resolved_env_path = env_path
+        _active = load_table(env_path) if env_path else None
+    return _active
+
+
+def active_hash() -> "str | None":
+    t = active_table()
+    return t.sha if t else None

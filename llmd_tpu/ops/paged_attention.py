@@ -30,6 +30,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from llmd_tpu.ops import attn_tune
+
 VMEM_LIMIT = 100 * 1024 * 1024
 
 
@@ -42,21 +44,36 @@ def _kernel():
     return rpa
 
 
-def pick_block_sizes(num_tokens: int, page_size: int, pages_per_seq: int) -> tuple[int, int]:
+def pick_block_sizes(num_tokens: int, page_size: int, pages_per_seq: int,
+                     *, head_layout: "str | None" = None) -> tuple[int, int]:
     """(num_kv_pages_per_block, num_queries_per_block) for our serving shapes.
 
-    KV blocks sized ~128 tokens keep decode DMAs overlapped without predicating
-    past short sequences (v5e sweep above); q blocks of 32 cover a full decode
-    batch row budget per program, 64+ for big prefill batches.
+    Resolution order, weakest to strongest:
 
-    ``LLMD_ATTN_BKV`` / ``LLMD_ATTN_BQ`` override the policy — bench.py's
-    on-chip auto-tuner sets them after timing candidates at the serving shape
-    (per-chip optima vary; see deploy/ENV_VARS.md).
+    1. **heuristic** — KV blocks sized ~128 tokens keep decode DMAs overlapped
+       without predicating past short sequences (v5e sweep above); q blocks of
+       32 cover a full decode batch row budget per program, 64+ for big
+       prefill batches,
+    2. **auto-tune table** (`ops.attn_tune`, loaded from
+       ``LLMD_ATTN_TUNE_FILE`` / `EngineConfig.attn_tune_file`) — bench.py's
+       on-chip tuner's per-(batch, page_size, head layout) winners; an exact
+       batch match replaces the heuristic, so b128 and long-context shapes
+       stop running block sizes swept at b32,
+    3. ``LLMD_ATTN_BKV`` / ``LLMD_ATTN_BQ`` env overrides — the operator
+       escape hatch (and the legacy single-shape tuner export), applied at
+       decode-gate shapes only (see deploy/ENV_VARS.md).
     """
     import os
 
     bkv = max(1, min(pages_per_seq, max(1, 128 // page_size)))
     bq = 32 if num_tokens <= 512 else 64
+    table = attn_tune.active_table()
+    if table is not None:
+        # exact (batch, page_size, head_layout) key; nearest pages_per_seq —
+        # non-tuned shapes (e.g. prefill token budgets) miss and keep policy
+        hit = table.lookup(num_tokens, page_size, pages_per_seq, head_layout)
+        if hit is not None:
+            bkv, bq = hit
     try:
         decode_n = int(os.environ.get("LLMD_ATTN_DECODE_N", "128"))
     except ValueError:
@@ -104,8 +121,10 @@ def paged_attention_tpu(
     models.transformer.ragged_paged_attention_xla on TPU)."""
     del positions, seq_slots, chunk_k, chunk_v
     N = q.shape[0]
-    _, ps, _, _ = layer_cache.shape
-    bkv, bq = pick_block_sizes(N, ps, page_tables.shape[1])
+    _, ps, planes, _ = layer_cache.shape
+    bkv, bq = pick_block_sizes(
+        N, ps, page_tables.shape[1],
+        head_layout=attn_tune.head_layout_key(q.shape[1], q.shape[2], planes))
     # -1 marks unmapped table entries in engine convention; the kernel's scalar-
     # prefetched DMA would read out of bounds — clamp to page 0 (never attended:
     # those entries lie at/past kv_len).

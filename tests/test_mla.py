@@ -120,7 +120,11 @@ def test_preemption_recompute_continues():
 
 def test_attn_backend_provenance():
     eng = _engine()
+    # auto off-TPU: the absorbed XLA impl is the DESIGNED backend for the
+    # mixed-batch programs (and for decode on CPU), not a fallback — the
+    # reason field must stay empty so real fallbacks are observable
     assert eng.attn_backend == "xla_mla_absorbed"
+    assert eng.attn_fallback_reason is None
     assert eng.kv_pack == 1  # nothing to pack: one shared latent head
     assert eng.sp_attn_backend is None  # no mesh on this engine → no sp ring
 
@@ -167,10 +171,75 @@ def test_lora_on_mla_raises():
         _engine(lora=LoRAConfig(max_adapters=2, rank=4))
 
 
-def test_explicit_pallas_on_mla_raises():
-    import pytest
-    with pytest.raises(ValueError, match="pallas.*MLA|MLA.*pallas"):
-        _engine(attn_impl="pallas")
+# -------------------------------------------------- latent-width Pallas decode
+
+
+def _latent_op_inputs(dtype):
+    """Build a paged latent pool at the tiny-mla decode shape: B=4 single-token
+    queries over a single-plane pool, real width 80 (rank 64 + rope 16)
+    zero-padded to the 128-lane boundary — the padding algebra both impls rely
+    on (zero q lanes x zero kv lanes contribute nothing to any dot)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    B, H, Dhp, real, ps, maxp, P = 4, 4, 128, 80, 8, 6, 16
+    q = np.zeros((B, H, Dhp), np.float32)
+    q[..., :real] = rng.normal(size=(B, H, real))
+    cache = np.zeros((P, ps, 1, Dhp), np.float32)
+    cache[..., :real] = rng.normal(size=(P, ps, 1, real))
+    kv_lens = np.array([1, 3, 17, 48], np.int32)  # partial/one/partial/full maxp
+    pt = -np.ones((B, maxp), np.int32)
+    nxt = 0
+    for b in range(B):
+        for j in range(-(-int(kv_lens[b]) // ps)):
+            pt[b, j] = nxt
+            nxt += 1
+    return (jnp.asarray(q, dtype), jnp.asarray(cache, dtype),
+            jnp.asarray(pt), jnp.asarray(kv_lens))
+
+
+def _latent_parity(dtype, tol):
+    import jax.numpy as jnp
+
+    from llmd_tpu.models.transformer import ragged_paged_attention_xla
+    from llmd_tpu.ops.mla_decode import mla_paged_attention_latent
+
+    q, cache, pt, kv_lens = _latent_op_inputs(dtype)
+    B = q.shape[0]
+    kw = dict(positions=kv_lens - 1, seq_slots=jnp.arange(B, dtype=jnp.int32),
+              kv_lens=kv_lens, scale=(64 + 16) ** -0.5)
+    ref = ragged_paged_attention_xla(q, cache, pt, **kw)
+    got = mla_paged_attention_latent(q, cache, pt, **kw)  # interpret on CPU
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(ref, np.float32), atol=tol, rtol=tol)
+
+
+def test_latent_decode_kernel_parity_fp32():
+    """The latent Pallas decode kernel vs the XLA reference, elementwise: the
+    online-softmax accumulation over pages must match the gather+mask softmax
+    at fp32 to float-roundoff, across empty/partial/full page tables."""
+    import jax.numpy as jnp
+    _latent_parity(jnp.float32, 2e-6)
+
+
+def test_latent_decode_kernel_parity_bf16():
+    import jax.numpy as jnp
+    _latent_parity(jnp.bfloat16, 2e-2)
+
+
+def test_explicit_pallas_latent_decode_serves_with_parity():
+    """attn_impl='pallas' on MLA (formerly a ValueError) now routes the fused-
+    decode program through the latent Pallas kernel — interpret-mode off-TPU —
+    while mixed-batch programs keep the absorbed XLA impl. Greedy tokens must
+    match the pure-reference engine exactly, and the backend/fallback
+    provenance must show a deliberate selection, not a silent fallback."""
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    eng = _engine(attn_impl="pallas")
+    assert eng.attn_backend == "pallas_mla_latent_decode"
+    assert eng.attn_fallback_reason is None
+    got = eng.generate(PROMPTS[:2], sp)
+    ref = _engine(attn_impl="reference").generate(PROMPTS[:2], sp)
+    assert got == ref
 
 
 def test_ring_prefill_parity_under_sp():

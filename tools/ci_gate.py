@@ -77,6 +77,11 @@ def main() -> int:
         stages.append(("bench-tiny-spec",
                        [py, "bench.py", "--tiny", "--cpu",
                         "--spec-mode", "ngram", "--workload", "echo"], None))
+        # attention auto-tune round trip (interpreter timings, real plumbing):
+        # candidate sweep -> tune-file merge -> engine load; bench asserts the
+        # engine-loaded table hash matches the exported one
+        stages.append(("bench-tiny-attn",
+                       [py, "bench.py", "--tiny", "--cpu", "--tune-attn"], None))
     if not args.skip_dryrun:
         n = 2 if args.quick else 8
         stages.append((f"dryrun-multichip-{n}",

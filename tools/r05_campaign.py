@@ -5,7 +5,17 @@ The fabric has been intermittent; this script is built to harvest whatever
 window it gets: every point is independent (a failure or a device drop mid-
 campaign keeps every completed point), bench.py's own preflight turns a dead
 fabric into a structured skip rather than a crash, and partial results are
-flushed to disk after every point.
+flushed to disk after every point. After any point times out, a cheap
+subprocess probe checks whether the fabric is still alive; if it is dead the
+remaining points are recorded as structured skips immediately instead of each
+paying bench.py's full 180s preflight (the r05 b128 run burned ~30 min
+discovering a fabric that died mid-point, one preflight at a time).
+
+Every bench subprocess shares one attention tune table
+(campaign_logs/attn_tune.json via LLMD_ATTN_TUNE_FILE): bench.py's on-chip
+tuner merges each point's winning block sizes into it, so later points (and
+re-runs after a fabric drop) start from the accumulated table, and each
+result row carries the loaded table's hash (attn_tune_hash) as provenance.
 
 Usage: python tools/r05_campaign.py [--out BENCH_CAMPAIGN_r05.json]
                                     [--skip baseline-bf16,int8,...]
@@ -33,9 +43,13 @@ POINTS: list[tuple[str, list[str]]] = [
     # default since the 2nd window): A/B against the harvested int8-b64 row
     # (4,042 tok/s), which pre-dates the deferred sample read
     ("int8-b64-pps", ["--quantize", "int8", "--batch", "64"]),
-    # b128's first attempt hit the 1500s ceiling — in hindsight the fabric
-    # died mid-point (the very next point found it dead), so retry early;
-    # per-point stderr logs now survive a timeout for real diagnosis
+    # b128's first attempt hit the 1500s ceiling — the fabric died mid-point
+    # (the very next point found it dead; CPU probes show per-step cost scales
+    # linearly b64->b128, no code pathology — see PERF.md round 6 and
+    # tests/test_paged_attention.py's bounded-cost regression). Retry early;
+    # per-point stderr logs survive a timeout and the post-timeout fabric
+    # probe above turns a repeat death into fast skips instead of 30 min of
+    # serial preflights
     ("int8-b128", ["--quantize", "int8", "--batch", "128"]),
     # layer-scan unroll A/B at the serving default: can XLA hide part of the
     # weight stream behind compute across layer boundaries?
@@ -75,6 +89,28 @@ POINTS: list[tuple[str, list[str]]] = [
 ]
 
 
+ATTN_TUNE_FILE = os.path.join(ROOT, "campaign_logs/attn_tune.json")
+
+
+def fabric_alive(timeout_s: float = 90.0) -> bool:
+    """Probe the TPU fabric in a throwaway subprocess (backend init is
+    process-fatal when the fabric is wedged, so it can't run in-process).
+
+    Much cheaper than bench.py's full preflight: no model build, no serve —
+    just backend init + device count. Used after a point times out to decide
+    between 'keep going' and 'fast-skip the rest with structured rows'.
+    """
+    cmd = [sys.executable, "-c",
+           "import jax; print(len(jax.devices('tpu')))"]
+    try:
+        p = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
+                           timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False
+    return p.returncode == 0 and p.stdout.strip().isdigit() \
+        and int(p.stdout.strip()) > 0
+
+
 def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
     cmd = [sys.executable, os.path.join(ROOT, "bench.py")] + extra
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
@@ -85,10 +121,15 @@ def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
     # throws away); stdout stays piped — it only carries the result JSON
     log_path = os.path.join(ROOT, f"campaign_logs/{name}.log")
     os.makedirs(os.path.dirname(log_path), exist_ok=True)
+    # every point reads AND extends the same attention tune table — later
+    # points inherit earlier points' block-size winners, and the engine
+    # stamps the table hash into the row (attn_tune_hash)
+    env = {**os.environ, "LLMD_ATTN_TUNE_FILE": ATTN_TUNE_FILE}
     try:
         with open(log_path, "w") as log:
             p = subprocess.run(cmd, cwd=ROOT, stdout=subprocess.PIPE,
-                               stderr=log, text=True, timeout=timeout_s)
+                               stderr=log, text=True, timeout=timeout_s,
+                               env=env)
     except subprocess.TimeoutExpired:
         return {"point": name, "error": f"timeout {timeout_s:.0f}s",
                 "log": log_path}
@@ -146,8 +187,26 @@ def main() -> None:
         print(json.dumps({"error": "every point skipped"}))
         return
     results: list[dict] = []
+    dead_after: "str | None" = None  # point whose timeout found the fabric dead
     for name, extra in points:
-        results.append(run_point(name, extra, args.timeout))
+        if dead_after is not None:
+            # fabric confirmed dead: structured skip, same shape as bench.py's
+            # own preflight skip rows, but issued here in ~0s instead of after
+            # another 2x180s in-subprocess preflight per point
+            results.append({"point": name, "error": "skipped",
+                            "note": f"fabric dead (probe failed after "
+                                    f"{dead_after!r} timed out)"})
+        else:
+            row = run_point(name, extra, args.timeout)
+            results.append(row)
+            if str(row.get("error", "")).startswith("timeout"):
+                # a timeout is ambiguous: slow point vs fabric death mid-point
+                # (the r05 b128 row was the latter). Disambiguate cheaply.
+                print(f"# {name} timed out; probing fabric...", file=sys.stderr)
+                if not fabric_alive():
+                    dead_after = name
+                    print("# fabric probe failed: fast-skipping remaining "
+                          "points", file=sys.stderr)
         prior_good = {r["point"] for r in prior if r.get("value")}
         # a completed re-run supersedes its prior entry; a FAILED re-run must
         # not replace a prior real measurement with an error row
@@ -163,6 +222,9 @@ def main() -> None:
             json.dump({
                 "campaign": "r05",
                 "reference_r03": {"value": 1930.0, "weights_bw_util": 0.153},
+                # shared tune table: each result row's attn_tune_hash tells
+                # which snapshot of this file the point actually served with
+                "attn_tune_file": os.path.relpath(ATTN_TUNE_FILE, ROOT),
                 "results": merged,
                 "best_serving": ({"point": best["point"], "value": best["value"],
                                   "weights_bw_util": best.get("weights_bw_util")}
