@@ -16,9 +16,13 @@ check-quick:
 test:
 	$(PY) -m pytest tests/ -q
 
+# full static-analysis suite: lock discipline, deadlock order, hot-path
+# purity, env/metrics/events contracts (docs/static-analysis.md)
 lint:
 	$(PY) tools/lint_envvars.py
 	$(PY) tools/lint_events.py
+	JAX_PLATFORMS=cpu $(PY) tools/lint_metrics.py
+	JAX_PLATFORMS=cpu $(PY) -m tools.llmd_lint
 
 manifests:
 	$(PY) tools/validate_manifests.py deploy

@@ -77,17 +77,26 @@ class MemoryQueuePuller(QueuePuller):
             return heapq.heappop(self._heap)[3]
 
     def nack(self, item: AsyncItem) -> None:
-        self._seq += 1
-        heapq.heappush(self._heap, (-item.priority, time.monotonic(), self._seq, item))
-        # Wake a worker parked in get(): nack runs on the event-loop thread, so
-        # the notify (which must hold the condition lock) is scheduled as a task.
+        # nack is sync; on the event-loop thread the locked re-push rides the
+        # wake-up task (heap mutation and notify both under the condition).
         try:
-            asyncio.get_running_loop().create_task(self._notify_one())
+            loop = asyncio.get_running_loop()
         except RuntimeError:
-            pass  # no running loop (sync caller): next put() will wake waiters
+            # No running loop (sync caller): no task can be inside a critical
+            # section, and the next put() will wake any waiters.
+            # llmd-lint: allow[lock-unguarded-write] no running event loop in this branch, so nothing can hold the condition
+            self._seq += 1
+            # llmd-lint: allow[lock-unguarded-read] same single-threaded fallback path as the write above
+            heapq.heappush(self._heap,
+                           (-item.priority, time.monotonic(), self._seq, item))
+            return
+        loop.create_task(self._requeue(item))
 
-    async def _notify_one(self) -> None:
+    async def _requeue(self, item: AsyncItem) -> None:
         async with self._cond:
+            self._seq += 1
+            heapq.heappush(self._heap,
+                           (-item.priority, time.monotonic(), self._seq, item))
             self._cond.notify()
 
 

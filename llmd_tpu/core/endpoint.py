@@ -122,7 +122,7 @@ class EndpointPool:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._eps: dict[str, Endpoint] = {}
-        self._listeners: list[Any] = []
+        self._listeners: list[Any] = []  # guarded-by: _lock
 
     def upsert(self, ep: Endpoint) -> None:
         with self._lock:
@@ -133,14 +133,16 @@ class EndpointPool:
                 existing.ready = ep.ready
                 return
             self._eps[ep.address] = ep
-        for fn in list(self._listeners):
+            listeners = list(self._listeners)
+        for fn in listeners:  # callbacks run outside the lock
             fn("added", ep)
 
     def remove(self, address: str) -> Optional[Endpoint]:
         with self._lock:
             ep = self._eps.pop(address, None)
+            listeners = list(self._listeners) if ep is not None else []
         if ep is not None:
-            for fn in list(self._listeners):
+            for fn in listeners:  # callbacks run outside the lock
                 fn("removed", ep)
         return ep
 
@@ -157,13 +159,15 @@ class EndpointPool:
 
     def subscribe(self, fn: Any) -> None:
         """fn(event: 'added'|'removed', endpoint) — endpoint-notification-source analogue."""
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def unsubscribe(self, fn: Any) -> None:
-        try:
-            self._listeners.remove(fn)
-        except ValueError:
-            pass
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
 
     def __len__(self) -> int:
         with self._lock:

@@ -180,7 +180,8 @@ class KVTransferSource:
             lib, h = self.native
             return {k: int(lib.kvt_stat(h, k.encode()))
                     for k in ("exports", "pulls", "notifies", "expired", "misses")}
-        return self._stats
+        with self._lock:  # snapshot: serving threads bump these counters
+            return dict(self._stats)
 
     # -- registry ----------------------------------------------------------
     def register(self, request_id: str, block_hashes: list[int],

@@ -21,6 +21,9 @@ class AsyncLLMEngine:
         self.engine = engine
         self._idle_sleep = idle_sleep_s
         self._lock = threading.Lock()
+        # request_id -> (caller loop, stream queue); written from caller
+        # event loops, drained/popped from the engine thread.
+        # guarded-by: _lock
         self._streams: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Queue]] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -43,13 +46,14 @@ class AsyncLLMEngine:
                 has_work = self.engine.has_work()
                 outputs = self.engine.step() if has_work else []
             for out in outputs:
-                entry = self._streams.get(out.request_id)
+                with self._lock:
+                    entry = self._streams.get(out.request_id)
+                    if out.finished:
+                        self._streams.pop(out.request_id, None)
                 if entry is None:
                     continue
                 loop, q = entry
                 loop.call_soon_threadsafe(q.put_nowait, out)
-                if out.finished:
-                    self._streams.pop(out.request_id, None)
             if not has_work:
                 time.sleep(self._idle_sleep)
 
@@ -66,14 +70,15 @@ class AsyncLLMEngine:
     ) -> AsyncIterator[EngineOutput]:
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
-        self._streams[request_id] = (loop, q)
         try:
-            with self._lock:
+            with self._lock:  # stream registration + admission are atomic
+                self._streams[request_id] = (loop, q)
                 self.engine.add_request(request_id, token_ids, sampling, lora_id,
                                         rank=rank, mm_items=mm_items,
                                         trace_ctx=trace_ctx)
         except ValueError:
-            self._streams.pop(request_id, None)
+            with self._lock:
+                self._streams.pop(request_id, None)
             raise
         try:
             while True:
@@ -82,7 +87,8 @@ class AsyncLLMEngine:
                 if out.finished:
                     return
         finally:
-            self._streams.pop(request_id, None)
+            with self._lock:
+                self._streams.pop(request_id, None)
             if request_id in self.engine.seqs:
                 with self._lock:
                     self.engine.abort(request_id)

@@ -272,13 +272,14 @@ class DPAsyncEngine(AsyncLLMEngine):
                 self.empty_steps += 1
                 time.sleep(self._idle_sleep)
             for out in outputs:
-                entry = self._streams.get(out.request_id)
+                with self._lock:
+                    entry = self._streams.get(out.request_id)
+                    if out.finished:
+                        self._streams.pop(out.request_id, None)
                 if entry is None:
                     continue
                 loop, q = entry
                 loop.call_soon_threadsafe(q.put_nowait, out)
-                if out.finished:
-                    self._streams.pop(out.request_id, None)
         self.worker.close()
 
 

@@ -1484,6 +1484,7 @@ class LLMEngine:
             jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok), *mm_args,
         )
         if self.cfg.instrument:
+            # llmd-lint: allow[hot-host-sync] instrument-gated timing barrier; off in production serving
             logits.block_until_ready()
         t2 = time.perf_counter()
         if self._eplb is not None:
@@ -1760,6 +1761,7 @@ class LLMEngine:
             jnp.asarray(cu), jnp.asarray([len(plan)], jnp.int32),
             jnp.asarray(lora_tok),
         )
+        # llmd-lint: allow[hot-host-sync] designed sync point: verify needs the greedy tokens on host to accept/reject the draft
         g = np.asarray(greedy)  # [NT] (device sync point)
         t2 = time.perf_counter()
         if self._eplb is not None:
@@ -1935,6 +1937,7 @@ class LLMEngine:
         n_tokens = 0
         if self._eplb is not None:
             self._eplb_record(rec["cnt"])
+        # llmd-lint: allow[hot-host-sync] designed sync point: the one deferred readback per decode step (dispatch/process split hides it behind the next dispatch)
         toks_out = np.asarray(rec["toks_out"])  # [k, B] (device sync point)
         t2 = time.perf_counter()
         now = time.monotonic()
@@ -2132,6 +2135,7 @@ class LLMEngine:
 
     def _sample_apply(self, rec: dict) -> None:
         """Read one dispatched sample's tokens (device sync point) and apply."""
+        # llmd-lint: allow[hot-host-sync] designed sync point: deferred sample readback, overlapped with the next dispatch
         sampled = np.asarray(rec["sampled"])
         now = time.monotonic()
         for i, s, slot in rec["rows"]:

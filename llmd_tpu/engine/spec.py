@@ -37,6 +37,7 @@ def propose_ngram_draft(token_ids: Sequence[int], k: int,
     L = len(token_ids)
     if k <= 0 or L < ngram_min + 1:
         return []
+    # llmd-lint: allow[hot-host-sync] token_ids is a host-side int list; no device transfer happens here
     arr = np.asarray(token_ids, dtype=np.int64)
     # n may not exceed L-1: the suffix itself must leave at least one earlier
     # position to match against.

@@ -188,16 +188,18 @@ class RemoteKVStoreServer:
                 elif op == "probe":
                     hashes = [int(h) for h in hdr["hashes"]]
                     have = self._prefix(hashes, touch=False)
-                    self.stats["hit_blocks"] += len(have)
-                    self.stats["miss_blocks"] += len(hashes) - len(have)
-                    self.stats["probes"] += 1
+                    with self._lock:
+                        self.stats["hit_blocks"] += len(have)
+                        self.stats["miss_blocks"] += len(hashes) - len(have)
+                        self.stats["probes"] += 1
                     _send_frame(conn, {"found": len(have)})
                 elif op == "get":
                     hashes = [int(h) for h in hdr["hashes"]]
                     have, blobs = self._get(hashes)
-                    self.stats["hit_blocks"] += len(have)
-                    self.stats["miss_blocks"] += len(hashes) - len(have)
-                    self.stats["gets"] += 1
+                    with self._lock:
+                        self.stats["hit_blocks"] += len(have)
+                        self.stats["miss_blocks"] += len(hashes) - len(have)
+                        self.stats["gets"] += 1
                     payload = b"".join(b for b, _d, _s in blobs)
                     meta = blobs[0] if blobs else (b"", "float32", ())
                     _send_frame(conn, {"found": len(blobs),

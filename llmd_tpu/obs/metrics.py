@@ -126,6 +126,7 @@ class _Family:
         if self.labelnames:
             raise ValueError(f"{self.name}: family has labels; use .labels()")
         key = ()
+        # llmd-lint: allow[lock-unguarded-read] double-checked fast path: dict get is atomic under the GIL and the miss path re-checks via setdefault under the lock
         child = self._children.get(key)
         if child is None:
             with self._lock:
@@ -143,7 +144,9 @@ class _Family:
         if self._fn is not None:
             yield "", "", float(self._fn()), None
             return
-        for key, child in self._children.items():
+        with self._lock:  # snapshot: .labels() can insert mid-scrape
+            children = list(self._children.items())
+        for key, child in children:
             for s in self._child_samples(key, child):
                 yield s if len(s) == 4 else (s[0], s[1], s[2], None)
 
@@ -382,7 +385,8 @@ class Registry:
             return sorted(self._families)
 
     def get(self, name: str) -> Optional[_Family]:
-        return self._families.get(name)
+        with self._lock:
+            return self._families.get(name)
 
     def collect(self) -> List[Tuple[str, str, float]]:
         """Flat (full_name, rendered_labels, value) sample list."""

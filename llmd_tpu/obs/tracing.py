@@ -247,9 +247,10 @@ class Tracer:
             self.export_errors += 1
 
     def close(self) -> None:
-        if self._jsonl_file is not None:
-            self._jsonl_file.close()
-            self._jsonl_file = None
+        with self._lock:
+            if self._jsonl_file is not None:
+                self._jsonl_file.close()
+                self._jsonl_file = None
 
 
 _GLOBAL: Optional[Tracer] = None

@@ -58,7 +58,8 @@ class LocalPredictor:
         if not samples:
             return
         model = LatencyModel()
-        model.version = self.model.version
+        with self._lock:
+            model.version = self.model.version
         if model.fit(samples):
             with self._lock:
                 model.train_count = self.model.train_count + 1

@@ -41,7 +41,8 @@ class GrammarCache:
         self.evictions = 0
 
     def __len__(self) -> int:
-        return len(self._entries)
+        with self._lock:
+            return len(self._entries)
 
     def get_or_compile(self, key: tuple,
                        builder: Callable[[], TokenGrammar]) -> tuple[TokenGrammar, bool]:

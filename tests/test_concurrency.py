@@ -1,0 +1,181 @@
+"""Threaded stress tests for the shared structures the lock analyzer guards.
+
+Dynamic counterpart of the static lock-discipline checks in
+``tools/llmd_lint`` (locks analyzer): each test hammers one structure —
+metrics registry, flight-recorder ring, resilience breaker map, endpoint
+pool — from many threads through a start barrier, then asserts a
+deterministic invariant. A dropped lock in any of these shows up here as a
+lost update, a RuntimeError from a mutated-during-iteration dict, or a
+corrupted ring.
+"""
+
+from __future__ import annotations
+
+import threading
+
+N_THREADS = 8
+N_OPS = 200
+
+
+def _hammer(fn, n_threads: int = N_THREADS) -> None:
+    """Run fn(thread_index) on n_threads threads through a start barrier;
+    re-raise the first worker exception."""
+    errors: list[BaseException] = []
+    barrier = threading.Barrier(n_threads)
+
+    def run(i: int) -> None:
+        try:
+            barrier.wait(timeout=10)
+            fn(i)
+        except BaseException as e:  # noqa: BLE001 - reported via assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True)
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "stress worker hung"
+    if errors:
+        raise errors[0]
+
+
+# ------------------------------------------------------------------ metrics
+
+
+def test_metrics_registry_concurrent_inc_and_scrape():
+    """Increments from N threads race a scraping thread; no lost updates and
+    no dict-mutated-during-iteration from collect()/samples()."""
+    from llmd_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    ctr = reg.counter("llmd_tpu:stress_ops_total", "stress",
+                      labelnames=("worker",))
+    shared = reg.counter("llmd_tpu:stress_shared_total", "stress")
+    hist = reg.histogram("llmd_tpu:stress_lat_s", "stress",
+                         buckets=(0.1, 1.0))
+
+    def work(i: int) -> None:
+        for k in range(N_OPS):
+            # fresh label children mid-scrape: the _children dict grows
+            # while another thread iterates a snapshot of it
+            ctr.labels(worker=f"w{i}-{k % 20}").inc()
+            shared.inc()
+            hist.observe(0.01 * (k % 7))
+            if k % 25 == 0:
+                for _name, _labels, _v in reg.collect():
+                    pass
+
+    _hammer(work)
+    assert shared.value == N_THREADS * N_OPS
+    collected = {(n, l): v for n, l, v in reg.collect()}
+    per_worker = [v for (n, _l), v in collected.items()
+                  if n == "llmd_tpu:stress_ops_total"]
+    assert sum(per_worker) == N_THREADS * N_OPS
+    count = [v for (n, l), v in collected.items()
+             if n == "llmd_tpu:stress_lat_s_count"]
+    assert sum(count) == N_THREADS * N_OPS
+
+
+# ----------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_concurrent_ring():
+    """start/record/finish from N threads against a small ring: eviction
+    keeps the ring bounded, every surviving record is internally consistent,
+    and snapshot() never throws mid-eviction."""
+    from llmd_tpu.obs.events import EVENT_CATALOG, FlightRecorder
+
+    flight = FlightRecorder(max_requests=64, max_events=8)
+    ev = sorted(EVENT_CATALOG)[0]
+
+    def work(i: int) -> None:
+        for k in range(N_OPS):
+            rid = f"r{i}-{k}"
+            flight.start(rid, model="stress")
+            flight.record(rid, ev, step=k)
+            flight.record_system("pool_scale_up", replicas=k)
+            if k % 10 == 0:
+                flight.snapshot()
+                flight.system_events()
+            flight.finish(rid, status="ok")
+
+    _hammer(work)
+    assert len(flight) <= 64
+    for row in flight.snapshot():
+        assert row["request_id"].startswith("r")
+
+
+# ---------------------------------------------------------------- resilience
+
+
+def test_resilience_breaker_map_concurrent():
+    """Breaker creation, success/failure marking, and snapshot() race across
+    a shared address set; the per-address failure windows stay bounded and
+    snapshot never sees a half-initialised breaker."""
+    from llmd_tpu.router.resilience import ResilienceManager
+
+    mgr = ResilienceManager()
+    addrs = [f"10.0.0.{j}:8000" for j in range(8)]
+
+    def work(i: int) -> None:
+        for k in range(N_OPS):
+            a = addrs[(i + k) % len(addrs)]
+            mgr.allow(a)
+            if k % 3 == 0:
+                mgr.on_failure(a, reason="stress")
+            else:
+                mgr.on_success(a)
+            if k % 7 == 0:
+                mgr.healthy(a)
+                mgr.snapshot()
+            if k % 41 == 0:
+                mgr.forget(a)
+
+    _hammer(work)
+    snap = mgr.snapshot()
+    assert isinstance(snap, dict)
+    for a in mgr.open_endpoints():
+        assert a in addrs
+
+
+# -------------------------------------------------------------- endpoint pool
+
+
+def test_endpoint_pool_concurrent_membership_and_listeners():
+    """upsert/remove race subscribe/unsubscribe and list(): no lost listener
+    registrations, and every callback fires with a real endpoint."""
+    from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+
+    pool = EndpointPool()
+    seen: list[str] = []
+    seen_lock = threading.Lock()
+
+    def listener(event: str, ep: Endpoint) -> None:
+        assert event in ("added", "removed") and ep.address
+        with seen_lock:
+            seen.append(event)
+
+    pool.subscribe(listener)
+
+    def work(i: int) -> None:
+        extra = lambda ev, ep: None  # noqa: E731
+        for k in range(N_OPS):
+            addr = f"10.1.{i}.{k % 16}:8000"
+            pool.upsert(Endpoint(address=addr))
+            pool.list()
+            len(pool)
+            pool.subscribe(extra)
+            pool.unsubscribe(extra)
+            if k % 2 == 0:
+                pool.remove(addr)
+
+    _hammer(work)
+    # the permanent listener survived the subscribe/unsubscribe churn
+    n_before = len(seen)
+    pool.upsert(Endpoint(address="10.9.9.9:8000"))
+    assert len(seen) == n_before + 1
+    # membership converged: every remaining endpoint is one a worker added
+    for ep in pool.list():
+        assert ep.address.startswith(("10.1.", "10.9."))

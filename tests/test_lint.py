@@ -122,6 +122,6 @@ def test_ci_gate_composes_stages():
     summary = json.loads(proc.stdout.strip().splitlines()[-1])
     assert summary["gate"] == "ok"
     assert [s["stage"] for s in summary["stages"]] == [
-        "lint-envvars", "lint-metrics", "lint-events", "validate-manifests",
-        "chaos-check", "structured-check", "slo-check"]
+        "lint-envvars", "lint-metrics", "lint-events", "llmd-lint",
+        "validate-manifests", "chaos-check", "structured-check", "slo-check"]
     assert all(s["ok"] for s in summary["stages"])

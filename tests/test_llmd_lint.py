@@ -1,0 +1,479 @@
+"""Self-tests for the llmd-lint static-analysis suite (tools/llmd_lint).
+
+Two layers:
+
+* fixture projects written to tmp_path — each seeded violation (unguarded
+  write, lock-order cycle, sleep-under-lock, ``.item()`` in a hot path,
+  undocumented env var, annotation misuse) must be caught, and the matching
+  clean fixture must produce zero findings;
+* the real repository — the full suite must exit clean (everything fixed or
+  allowlisted with a justification) and the lock graph must cover the
+  acceptance floor of classes.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools.llmd_lint import core, envcontract, hotpath, locks
+from tools.llmd_lint.__main__ import run_suite
+
+
+def _project(tmp_path: Path, source: str,
+             rel: str = "llmd_tpu/fixt.py") -> core.Project:
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return core.Project(tmp_path)
+
+
+def _checks(findings) -> set[str]:
+    return {f.check for f in findings}
+
+
+# ------------------------------------------------------------ lock discipline
+
+
+def test_catches_unguarded_write(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def sneak(self, x):
+                self._items.append(x)   # mutation without the lock
+    """)
+    fs = locks.run(proj)
+    assert any(f.check == "lock-unguarded-write" and "sneak" in f.message
+               and "_items" in f.message for f in fs)
+
+
+def test_clean_locking_fixture_is_quiet(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def drain(self):
+                with self._lock:
+                    out = list(self._items)
+                    self._items = []
+                return out
+    """)
+    assert locks.run(proj) == []
+
+
+def test_private_helper_inherits_held_lock(tmp_path):
+    """The _breaker/_transition idiom: a private helper only ever called
+    under the lock is not a violation — including recursive helpers."""
+    proj = _project(tmp_path, """\
+        import threading
+
+        class Nested:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._depth = 0
+
+            def enter(self, n):
+                with self._lock:
+                    self._step(n)
+
+            def _step(self, n):
+                self._depth += 1
+                if n:
+                    self._step(n - 1)
+    """)
+    assert locks.run(proj) == []
+
+
+def test_catches_lock_order_cycle(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+
+        class A:
+            def __init__(self, b: "B"):
+                self._lock = threading.Lock()
+                self.b = b
+                self.x = 0
+
+            def ping(self):
+                with self._lock:
+                    self.b.pong()
+
+            def poke(self):
+                with self._lock:
+                    self.x = 1
+
+        class B:
+            def __init__(self, a: "A"):
+                self._lock = threading.Lock()
+                self.a = a
+                self.y = 0
+
+            def pong(self):
+                with self._lock:
+                    self.y = 2
+
+            def kick(self):
+                with self._lock:
+                    self.a.poke()
+    """)
+    fs = locks.run(proj)
+    cyc = [f for f in fs if f.check == "lock-order-cycle"]
+    assert cyc, [f.message for f in fs]
+    assert any("A._lock" in f.message and "B._lock" in f.message for f in cyc)
+
+
+def test_catches_self_deadlock_reacquire(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+
+        class Re:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    self.n += 1
+    """)
+    fs = locks.run(proj)
+    # inner is public, so no held-inheritance: the direct re-acquire is only
+    # visible via outer -> inner; make inner private to pin the diagnosis
+    proj2 = _project(tmp_path / "re2", """\
+        import threading
+
+        class Re:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    self.n += 1
+    """)
+    fs2 = locks.run(proj2)
+    assert any(f.check == "lock-order-cycle" and "self-deadlock" in f.message
+               for f in fs2), [f.message for f in fs + fs2]
+
+
+def test_rlock_reacquire_is_fine(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+
+        class Re:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.n = 0
+
+            def outer(self):
+                with self._lock:
+                    self._inner()
+
+            def _inner(self):
+                with self._lock:
+                    self.n += 1
+    """)
+    assert locks.run(proj) == []
+
+
+def test_catches_sleep_under_lock(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = 0
+
+            def tick(self):
+                with self._lock:
+                    time.sleep(0.5)
+                    self.state += 1
+    """)
+    fs = locks.run(proj)
+    assert any(f.check == "lock-blocking-call" and "time.sleep" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+def test_semaphore_is_not_a_guard(tmp_path):
+    """async-with on a Semaphore bounds concurrency; it must not make the
+    attributes written inside look lock-guarded."""
+    proj = _project(tmp_path, """\
+        import asyncio
+
+        class Gate:
+            def __init__(self):
+                self._sem = asyncio.Semaphore(4)
+                self.done = 0
+
+            async def run(self):
+                async with self._sem:
+                    self.done += 1
+
+            def report(self):
+                return self.done
+    """)
+    assert locks.run(proj) == []
+
+
+def test_guarded_by_annotation_enforced(tmp_path):
+    """An explicit '# guarded-by: _lock' protects attrs the inference can't
+    see (never written under the lock in-tree) — reads elsewhere then flag."""
+    proj = _project(tmp_path, """\
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []  # guarded-by: _lock
+
+            def subscribe(self, fn):
+                self._listeners.append(fn)
+    """)
+    fs = locks.run(proj)
+    assert any(f.check == "lock-unguarded-write" and "subscribe" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+def test_guarded_by_unknown_lock_flagged(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []  # guarded-by: _mutex
+
+            def poke(self):
+                with self._lock:
+                    self._listeners = []
+    """)
+    fs = locks.run(proj)
+    assert any(f.check == "guard-unknown-lock" for f in fs)
+
+
+# ------------------------------------------------------------ hot-path purity
+
+
+HOT_FIXTURE_PATHS = {"llmd_tpu/fixt.py": "*"}
+
+
+def test_catches_item_in_hot_path(tmp_path):
+    proj = _project(tmp_path, """\
+        import jax.numpy as jnp
+
+        def decode_step(logits):
+            probs = jnp.exp(logits)
+            return probs.item()
+    """)
+    fs = hotpath.run(proj, hot_paths=HOT_FIXTURE_PATHS)
+    assert any(f.check == "hot-host-sync" and ".item()" in f.message
+               for f in fs), [f.message for f in fs]
+
+
+def test_catches_jit_in_loop_and_token_loop(tmp_path):
+    proj = _project(tmp_path, """\
+        import jax
+
+        def decode(fns, n_tokens, xs):
+            outs = []
+            for t in range(n_tokens):
+                f = jax.jit(fns[t])
+                outs.append(f(xs))
+            return outs
+    """)
+    fs = hotpath.run(proj, hot_paths=HOT_FIXTURE_PATHS)
+    assert any(f.check == "hot-jit-in-loop" for f in fs)
+    assert any(f.check == "hot-token-loop" for f in fs)
+
+
+def test_clean_hot_path_is_quiet(tmp_path):
+    proj = _project(tmp_path, """\
+        import jax.numpy as jnp
+
+        def decode_step(step_fn, state, batch):
+            state, out = step_fn(state, batch)
+            return state, out
+    """)
+    assert hotpath.run(proj, hot_paths=HOT_FIXTURE_PATHS) == []
+
+
+def test_host_asarray_needs_allow(tmp_path):
+    """np.asarray in a hot path is flagged unless annotated — every readback
+    must carry its justification."""
+    proj = _project(tmp_path, """\
+        import numpy as np
+
+        def decode_step(toks):
+            # llmd-lint: allow[hot-host-sync] host-side list, no transfer
+            arr = np.asarray(toks)
+            return arr
+    """)
+    fs = hotpath.run(proj, hot_paths=HOT_FIXTURE_PATHS)
+    core.apply_inline_allows(proj, fs)
+    assert fs and all(f.allowed for f in fs)
+
+
+# ------------------------------------------------------------- env contract
+
+
+def test_catches_undocumented_env_var(tmp_path):
+    proj = _project(tmp_path, """\
+        import os
+
+        FLAG = os.environ.get("LLMD_FIXTURE_UNDOCUMENTED", "0")
+    """)
+    (tmp_path / "deploy").mkdir()
+    (tmp_path / "deploy" / "ENV_VARS.md").write_text(
+        "| Var | Consumer | Description |\n|---|---|---|\n")
+    fs = envcontract.run(proj)
+    assert any(f.check == "env-undocumented"
+               and "LLMD_FIXTURE_UNDOCUMENTED" in f.message for f in fs)
+
+
+def test_catches_wrapper_env_read_and_stale_row(tmp_path):
+    """The AST scanner sees _env_f("LLMD_X", ...) wrapper reads (the old
+    regex linter could not), and flags contract rows nothing reads."""
+    proj = _project(tmp_path, """\
+        import os
+
+        def _env_f(name, default):
+            return float(os.environ.get(name, default))
+
+        TIMEOUT = _env_f("LLMD_FIXTURE_WRAPPED", 1.0)
+    """)
+    (tmp_path / "deploy").mkdir()
+    (tmp_path / "deploy" / "ENV_VARS.md").write_text(
+        "| Var | Consumer | Description |\n|---|---|---|\n"
+        "| `LLMD_FIXTURE_WRAPPED` | `llmd_tpu.fixt` | wrapped knob |\n"
+        "| `LLMD_FIXTURE_GONE` | `llmd_tpu.fixt` | removed knob |\n")
+    fs = envcontract.run(proj)
+    checks = _checks(fs)
+    assert "env-undocumented" not in checks  # the wrapper read was seen
+    assert any(f.check == "env-doc-stale" and "LLMD_FIXTURE_GONE" in f.message
+               for f in fs)
+
+
+def test_catches_consumer_drift(tmp_path):
+    proj = _project(tmp_path, """\
+        import os
+
+        MODE = os.environ.get("LLMD_FIXTURE_MOVED", "a")
+    """, rel="llmd_tpu/newhome.py")
+    (tmp_path / "deploy").mkdir()
+    (tmp_path / "deploy" / "ENV_VARS.md").write_text(
+        "| Var | Consumer | Description |\n|---|---|---|\n"
+        "| `LLMD_FIXTURE_MOVED` | `llmd_tpu.oldhome` | moved knob |\n")
+    fs = envcontract.run(proj)
+    assert any(f.check == "env-consumer-drift" for f in fs)
+
+
+# ------------------------------------------------------- annotation hygiene
+
+
+def test_allow_without_justification_rejected(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = 0
+
+            def tick(self):
+                with self._lock:
+                    # llmd-lint: allow[lock-blocking-call]
+                    time.sleep(0.5)
+                    self.state += 1
+    """)
+    fs = locks.run(proj)
+    core.apply_inline_allows(proj, fs)
+    assert any(f.check == "lock-blocking-call" and not f.allowed for f in fs)
+    notes = core.annotation_findings(proj, fs)
+    assert any(n.check == "allow-missing-justification" for n in notes)
+
+
+def test_unused_allow_flagged(tmp_path):
+    proj = _project(tmp_path, """\
+        # llmd-lint: allow[lock-blocking-call] nothing here blocks any more
+        X = 1
+    """)
+    notes = core.annotation_findings(proj, [])
+    assert any(n.check == "allow-unused" for n in notes)
+
+
+def test_justified_allow_suppresses_and_is_echoed(tmp_path):
+    proj = _project(tmp_path, """\
+        import threading
+        import time
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = 0
+
+            def tick(self):
+                with self._lock:
+                    # llmd-lint: allow[lock-blocking-call] startup-only warm path, never per-request
+                    time.sleep(0.5)
+                    self.state += 1
+    """)
+    fs = locks.run(proj)
+    core.apply_inline_allows(proj, fs)
+    blocked = [f for f in fs if f.check == "lock-blocking-call"]
+    assert blocked and all(f.allowed for f in blocked)
+    assert "startup-only" in blocked[0].justification
+    assert core.annotation_findings(proj, fs) == []
+
+
+# ------------------------------------------------------------- the real repo
+
+
+def test_repo_suite_is_clean():
+    """Acceptance: the full suite over the repository exits with zero
+    unallowlisted findings."""
+    project = core.Project()
+    findings, _summaries = run_suite(project)
+    failures = [f for f in findings if not f.allowed]
+    assert failures == [], [
+        f"{f.check} {f.location()}: {f.message}" for f in failures]
+
+
+def test_repo_lock_graph_covers_acceptance_floor():
+    """Acceptance: the cross-class acquisition graph models >= 15 classes
+    holding locks, and every allowlisted suppression carries a reason."""
+    project = core.Project()
+    summary = locks.summary(project)
+    assert summary["num_classes"] >= 15, summary
+    findings, _ = run_suite(project)
+    for f in findings:
+        if f.allowed:
+            assert f.justification and f.justification.strip()

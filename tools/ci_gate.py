@@ -55,6 +55,9 @@ def main() -> int:
         ("lint-envvars", [py, "tools/lint_envvars.py"], None),
         ("lint-metrics", [py, "tools/lint_metrics.py"], CPU_ENV),
         ("lint-events", [py, "tools/lint_events.py"], CPU_ENV),
+        # unified static analysis: lock discipline, deadlock order, hot-path
+        # purity, env/metrics/events contracts (docs/static-analysis.md)
+        ("llmd-lint", [py, "-m", "tools.llmd_lint"], CPU_ENV),
         ("validate-manifests", [py, "tools/validate_manifests.py", "deploy"], None),
         ("chaos-check", [py, "tools/chaos_check.py"], CPU_ENV),
         # structured outputs: constrained generations must conform 100% and

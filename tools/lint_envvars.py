@@ -1,117 +1,58 @@
 #!/usr/bin/env python3
-"""Env-var contract linter (A8): code, images, and manifests must agree.
+"""Env-var contract linter (CI stage lint-envvars) — shim over
+tools/llmd_lint/envcontract.py.
 
-The reference enforces the same discipline with two linters
-(`/root/reference/scripts/lint-envvars.py`, `lint-dockerfile-envvars.py`); this
-stack keeps ONE contract table (`deploy/ENV_VARS.md`) and checks:
-
-1. every env var the Python source reads appears in the contract;
-2. every env var set by `docker/Dockerfile.tpu` ENV lines or a `deploy/`
-   manifest ``env:`` block appears in the contract AND is consumed somewhere
-   (source code, or marked ``(external)`` for platform vars owned by deps).
+The framework analyzer finds env reads by AST, so it also sees the wrapper
+idiom the old regex patterns were blind to (``_env_f("LLMD_X", d)``,
+``_env_i`` — the ResilienceConfig.from_env style). This entry point keeps the
+original one-directional checks (undocumented reads, undocumented artifact
+vars, dead knobs) and output format; the full bidirectional contract check
+(stale rows, consumer drift) runs in the ``llmd-lint`` stage.
 
 Run directly (CI) or via tests/test_lint.py. Exit 0 = contract holds.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
 
-READ_PATTERNS = [
-    re.compile(r"os\.environ\.get\(\s*[\"']([A-Z_][A-Z0-9_]*)[\"']"),
-    re.compile(r"os\.environ\[\s*[\"']([A-Z_][A-Z0-9_]*)[\"']\s*\]"),
-    re.compile(r"os\.getenv\(\s*[\"']([A-Z_][A-Z0-9_]*)[\"']"),
-]
-# writes (os.environ["X"] = ...) count as configuration, not consumption
-WRITE_PATTERN = re.compile(
-    r"os\.environ\[\s*[\"']([A-Z_][A-Z0-9_]*)[\"']\s*\]\s*=")
+from tools.llmd_lint import envcontract as _ec  # noqa: E402
+from tools.llmd_lint.core import Project  # noqa: E402
+
+# checks this entry point enforces (the historical lint_envvars contract)
+_LEGACY_CHECKS = ("env-undocumented", "env-artifact-undocumented",
+                  "env-dead-knob")
 
 
 def vars_read_in_source() -> dict[str, list[str]]:
-    found: dict[str, list[str]] = {}
-    for base in ("llmd_tpu", "tools", "helpers"):
-        for py in (ROOT / base).rglob("*.py"):
-            text = py.read_text(errors="replace")
-            writes = set(WRITE_PATTERN.findall(text))
-            for pat in READ_PATTERNS:
-                for var in pat.findall(text):
-                    if var in writes and pat is READ_PATTERNS[1]:
-                        continue
-                    found.setdefault(var, []).append(str(py.relative_to(ROOT)))
-    for py in (ROOT / "bench.py", ROOT / "__graft_entry__.py"):
-        if py.exists():
-            for pat in READ_PATTERNS:
-                for var in pat.findall(py.read_text(errors="replace")):
-                    found.setdefault(var, []).append(py.name)
-    return found
+    return _ec.vars_read_in_source(Project(ROOT))
 
 
 def vars_set_in_artifacts() -> dict[str, list[str]]:
-    out: dict[str, list[str]] = {}
-    df = ROOT / "docker" / "Dockerfile.tpu"
-    if df.exists():
-        in_env = False
-        for line in df.read_text().splitlines():
-            stripped = line.strip()
-            if in_env and stripped.startswith("#"):
-                continue  # Docker permits comment lines inside continuations
-            if stripped.startswith("ENV "):
-                in_env = True
-                stripped = stripped[4:]
-            if in_env:
-                for m in re.finditer(r"([A-Z_][A-Z0-9_]*)=", stripped):
-                    out.setdefault(m.group(1), []).append("docker/Dockerfile.tpu")
-                if not line.rstrip().endswith("\\"):
-                    in_env = False
-    for manifest in (ROOT / "deploy").rglob("*.yaml"):
-        text = manifest.read_text(errors="replace")
-        # k8s container env entries:  - name: VAR
-        for m in re.finditer(r"-\s+name:\s+([A-Z_][A-Z0-9_]*)\s*\n\s+value:", text):
-            out.setdefault(m.group(1), []).append(str(manifest.relative_to(ROOT)))
-    return out
+    return _ec.vars_set_in_artifacts(ROOT)
 
 
 def contract_vars() -> dict[str, str]:
-    doc = (ROOT / "deploy" / "ENV_VARS.md").read_text()
-    rows: dict[str, str] = {}
-    for m in re.finditer(r"^\|\s*`([A-Z_][A-Z0-9_]*)`\s*\|\s*([^|]+)\|", doc, re.M):
-        rows[m.group(1)] = m.group(2).strip()
-    return rows
+    return _ec.contract_rows(ROOT)
 
 
 def lint() -> list[str]:
-    errors: list[str] = []
-    contract = contract_vars()
-    read = vars_read_in_source()
-    for var, where in sorted(read.items()):
-        if var not in contract:
-            errors.append(
-                f"{var}: read by {sorted(set(where))} but missing from deploy/ENV_VARS.md")
-    setters = vars_set_in_artifacts()
-    for var, where in sorted(setters.items()):
-        if var not in contract:
-            errors.append(
-                f"{var}: set in {sorted(set(where))} but missing from deploy/ENV_VARS.md")
-            continue
-        consumer = contract[var]
-        if "(external)" in consumer:
-            continue  # owned by a dependency (jax/xla/python/k8s)
-        if var not in read:
-            errors.append(
-                f"{var}: set in {sorted(set(where))}, documented as consumed by "
-                f"{consumer!r}, but nothing in the source reads it (dead knob)")
-    return errors
+    findings = _ec.evaluate(contract_vars(), vars_read_in_source(),
+                            vars_set_in_artifacts())
+    return [f.message for f in findings if f.check in _LEGACY_CHECKS]
 
 
 def main() -> int:
     errors = lint()
     for e in errors:
         print(f"ENVVAR-LINT: {e}")
-    print(f"env-var contract: {'OK' if not errors else f'{len(errors)} violation(s)'}")
+    print(f"env-var contract: "
+          f"{'OK' if not errors else f'{len(errors)} violation(s)'}")
     return 1 if errors else 0
 
 

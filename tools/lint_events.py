@@ -1,102 +1,45 @@
 #!/usr/bin/env python3
-"""Flight-recorder event-catalog linter.
+"""Flight-recorder event-catalog linter (CI stage lint-events) — shim over
+tools/llmd_lint/events_contract.py.
 
-Three sources must agree on the set of per-request event names:
+Three sources must agree on the per-request event names: the authoritative
+``EVENT_CATALOG`` in ``llmd_tpu/obs/events.py``, the emit sites across
+``llmd_tpu/``, and the operator docs table in
+``observability/flight-recorder.md``. The checked contract and output format
+are unchanged from the pre-framework linter; the same analyzer also runs in
+the ``llmd-lint`` stage.
 
-1. ``EVENT_CATALOG`` in ``llmd_tpu/obs/events.py`` — the authoritative list;
-2. the emit sites — every ``flight.record(rid, "<name>", ...)``,
-   ``flight.record_system("<name>", ...)`` and ``flight.finish(rid,
-   event="<name>", ...)`` call across ``llmd_tpu/``;
-3. the operator docs — the event-catalog table in
-   ``observability/flight-recorder.md``.
-
-Failures:
-
-* an emit site using a name missing from ``EVENT_CATALOG`` (typo'd or
-  unregistered event — would silently fragment timelines);
-* a catalog entry no code path ever emits (dead/dangling event);
-* the doc table out of sync with the catalog in either direction.
-
-Run directly (CI via tools/ci_gate.py) or through tests. Exit 0 = in sync.
+Run directly (CI) or via tests/test_lint.py. Exit 0 = contract holds.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
 
-# flight.record(<rid>, "<event>", ...) / flight.record_system("<event>", ...)
-# / flight.finish(<rid>, event="<event>", ...). Emit sites always use literal
-# names — that's what makes the contract lintable.
-RECORD_PAT = re.compile(r"\.record\(\s*[^,()]+,\s*\"([a-z_]+)\"")
-RECORD_SYSTEM_PAT = re.compile(r"\.record_system\(\s*\"([a-z_]+)\"")
-FINISH_EVENT_PAT = re.compile(r"\bevent=\"([a-z_]+)\"")
-
-# doc table rows: | `event_name` | ... |
-DOC_ROW_PAT = re.compile(r"^\|\s*`([a-z_]+)`", re.MULTILINE)
+from tools.llmd_lint import events_contract as _ev  # noqa: E402
 
 
 def catalog_events() -> set[str]:
-    sys.path.insert(0, str(ROOT))
-    try:
-        from llmd_tpu.obs.events import EVENT_CATALOG
-    finally:
-        sys.path.remove(str(ROOT))
-    return set(EVENT_CATALOG)
+    return _ev.catalog_events(ROOT)
 
 
 def emitted_events() -> dict[str, list[str]]:
-    """event name → files emitting it, scanned from llmd_tpu/ source
-    (obs/events.py itself is the declaration, not an emit site)."""
-    out: dict[str, list[str]] = {}
-    for path in sorted((ROOT / "llmd_tpu").rglob("*.py")):
-        if path.name == "events.py" and path.parent.name == "obs":
-            continue
-        text = path.read_text()
-        rel = str(path.relative_to(ROOT))
-        for pat in (RECORD_PAT, RECORD_SYSTEM_PAT, FINISH_EVENT_PAT):
-            for name in pat.findall(text):
-                out.setdefault(name, [])
-                if rel not in out[name]:
-                    out[name].append(rel)
-    return out
+    return _ev.emitted_events(ROOT)
 
 
 def documented_events() -> set[str]:
-    doc = ROOT / "observability" / "flight-recorder.md"
-    if not doc.exists():
-        return set()
-    return set(DOC_ROW_PAT.findall(doc.read_text()))
+    return _ev.documented_events(ROOT)
 
 
 def main() -> int:
     catalog = catalog_events()
-    emitted = emitted_events()
-    documented = documented_events()
-    errors: list[str] = []
-
-    for name in sorted(set(emitted) - catalog):
-        errors.append(
-            f"emitted but not in EVENT_CATALOG: {name!r} "
-            f"(from {', '.join(emitted[name])})")
-    for name in sorted(catalog - set(emitted)):
-        errors.append(f"in EVENT_CATALOG but never emitted: {name!r}")
-    if not documented:
-        errors.append("observability/flight-recorder.md missing or has no "
-                      "event-catalog table rows (| `event` | ...)")
-    else:
-        for name in sorted(catalog - documented):
-            errors.append(
-                f"in EVENT_CATALOG but undocumented in "
-                f"observability/flight-recorder.md: {name!r}")
-        for name in sorted(documented - catalog):
-            errors.append(
-                f"documented in observability/flight-recorder.md but not in "
-                f"EVENT_CATALOG: {name!r}")
-
+    errors = [f.message for f in _ev.evaluate(
+        catalog, emitted_events(), documented_events())]
     if errors:
         print(f"lint_events: {len(errors)} error(s)")
         for e in errors:

@@ -1,112 +1,45 @@
 #!/usr/bin/env python3
-"""Metrics contract linter: the observability kit must only reference metric
-families the stack actually emits.
+"""Metrics doc-contract linter (CI stage lint-metrics) — shim over
+tools/llmd_lint/metrics_contract.py.
 
-Sources of truth, in order:
+The observability kit (grafana dashboards, alert rules, the promql cookbook)
+must only reference metric families the stack actually emits: the shared
+registry's declared families (expanded with histogram/summary series
+suffixes) plus raw-line providers found by scanning the source. The checked
+contract and output format are unchanged from the pre-framework linter; the
+same analyzer also runs in the ``llmd-lint`` stage.
 
-1. the registry — `llmd_tpu.obs.metrics.register_*` declare every family the
-   engine, engine frontends, and router expose through `Registry.expose()`;
-   histograms/summaries also emit their `_bucket`/`_sum`/`_count` series;
-2. raw-line providers — plugins that append pre-rendered exposition lines
-   (latency predictor, ext-proc front, HA coordinator, predictor sidecar) are
-   found by scanning the source for family-shaped names.
-
-Checked consumers: `observability/grafana/*.json` panel targets,
-`observability/alerts.yaml` rule expressions, and the `observability/promql.md`
-cookbook. Any referenced family not emitted anywhere is a dangling reference.
-
-Run directly (CI via tools/ci_gate.py) or through tests. Exit 0 = no dangling
-references.
+Run directly (CI) or via tests/test_lint.py. Exit 0 = contract holds.
 """
 
 from __future__ import annotations
 
-import json
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
 
-# family-shaped names used across the stack (same pattern test_lint.py uses)
-METRIC_PAT = re.compile(
-    r"(llmd_tpu:[a-z_]+|llm_d_epp_[a-z_]+|igw_[a-z_]+|vllm:[a-z_]+"
-    r"|inference_objective_[a-z_]+)")
+from tools.llmd_lint import metrics_contract as _mc  # noqa: E402
+from tools.llmd_lint.metrics_contract import METRIC_PAT  # noqa: E402,F401
 
 
 def registry_families() -> set[str]:
-    """Every family name the shared registry declares, expanded with the
-    series suffixes histograms and summaries emit."""
-    sys.path.insert(0, str(ROOT))
-    try:
-        from llmd_tpu.obs.metrics import (
-            Histogram,
-            Registry,
-            Summary,
-            register_engine_metrics,
-            register_engine_server_metrics,
-            register_pool_metrics,
-            register_router_metrics,
-        )
-    finally:
-        sys.path.remove(str(ROOT))
-
-    reg = Registry()
-    register_engine_metrics(reg)
-    register_engine_server_metrics(reg)
-    register_router_metrics(reg)
-    register_pool_metrics(reg)
-    names: set[str] = set()
-    for name in reg.families():
-        names.add(name)
-        fam = reg.get(name)
-        if isinstance(fam, Histogram):
-            names |= {name + "_bucket", name + "_sum", name + "_count"}
-        elif isinstance(fam, Summary):
-            names |= {name + "_sum", name + "_count"}
-    return names
+    return _mc.registry_families(ROOT)
 
 
 def rawline_families() -> set[str]:
-    """Family names emitted as pre-rendered lines (plugin providers, sidecars)
-    anywhere in the source tree."""
-    names: set[str] = set()
-    for py in (ROOT / "llmd_tpu").rglob("*.py"):
-        names |= set(METRIC_PAT.findall(py.read_text(errors="replace")))
-    return names
+    return _mc.rawline_families(ROOT)
 
 
 def referenced() -> dict[str, list[str]]:
-    """Metric names referenced by the observability kit → referencing files."""
-    refs: dict[str, list[str]] = {}
-
-    def note(name: str, where: str) -> None:
-        refs.setdefault(name, []).append(where)
-
-    for dash in sorted((ROOT / "observability" / "grafana").glob("*.json")):
-        doc = json.loads(dash.read_text())
-        for panel in doc.get("panels", []):
-            for tgt in panel.get("targets", []):
-                for m in METRIC_PAT.findall(tgt.get("expr", "")):
-                    note(m, f"grafana/{dash.name}")
-    alerts = ROOT / "observability" / "alerts.yaml"
-    for m in METRIC_PAT.findall(alerts.read_text()):
-        note(m, "alerts.yaml")
-    promql = ROOT / "observability" / "promql.md"
-    for m in METRIC_PAT.findall(promql.read_text()):
-        note(m, "promql.md")
-    return refs
+    return _mc.referenced(ROOT)
 
 
 def lint() -> list[str]:
     emitted = registry_families() | rawline_families()
-    errors: list[str] = []
-    for name, where in sorted(referenced().items()):
-        if name not in emitted:
-            errors.append(
-                f"{name}: referenced by {sorted(set(where))} but no registry "
-                f"family or raw-line provider emits it")
-    return errors
+    return [f.message for f in _mc.evaluate(emitted, referenced())]
 
 
 def main() -> int:

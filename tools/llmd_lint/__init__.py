@@ -1,0 +1,11 @@
+"""llmd-lint: the unified contract-lint framework over llmd_tpu/.
+
+Run the full suite with ``python -m tools.llmd_lint`` (add ``--json`` for
+machine-readable output, ``--analyzer NAME`` to run a subset). Analyzer
+catalog, annotation grammar and worked examples: docs/static-analysis.md.
+"""
+
+from .core import AllowEntry, Finding, Project  # noqa: F401
+
+ANALYZER_NAMES = ("locks", "hotpath", "env-contract", "metrics-contract",
+                  "events-contract")

@@ -1,0 +1,226 @@
+"""Hot-path purity analyzer.
+
+Over the declared hot-path set (``config.HOT_PATHS``: the engine's
+step/dispatch/verify/sample functions and every op kernel) this flags the
+bug classes a compiled-program serving loop cannot afford:
+
+* ``hot-host-sync`` — device→host synchronization: ``.item()``,
+  ``.tolist()``, ``.block_until_ready()``, ``np.asarray``/``np.array`` and
+  ``jax.device_get`` on device values, plus ``float()``/``int()``/``bool()``
+  over a value a local-dataflow pass saw come out of a ``jnp``/``jax`` call
+  (implicit transfer). Each sync stalls the dispatch pipeline for a full
+  device round trip; the designed sync points carry inline allows with
+  their justification.
+* ``hot-implicit-bool`` — branching directly on a device value (``if x:``)
+  forces the same transfer without spelling it.
+* ``hot-jit-in-loop`` — ``jax.jit``/``jax.pmap`` under a ``for``/``while``
+  in a hot file builds a fresh compiled callable per iteration (the
+  recompile-storm class ``test_paged_attention.py`` pins dynamically);
+  ``hot-jit-call`` flags any jit construction inside a hot function, where
+  per-request tracing is never acceptable.
+* ``hot-token-loop`` — a Python-level per-token loop (``for _ in
+  range(<...token...>)``) in a hot function: work that belongs inside the
+  compiled program.
+
+The dataflow is local and deliberately shallow: a name becomes "device"
+when assigned from ``jnp.*``/``jax.*`` (except the host-returning calls),
+from a compiled-program attribute call (``self._*_fn(...)``), or by
+indexing another device value. No inter-procedural tracking — silence over
+noise.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Optional, Union
+
+from . import config
+from .core import Finding, Project, SourceFile, dotted_name
+
+# jnp/jax calls that already return host values — not device producers
+_HOST_RETURNING = {"jax.device_get", "jnp.save", "jax.debug.print"}
+_DEVICE_ROOTS = ("jnp.", "jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.")
+
+
+def _hot_functions(spec, sf: SourceFile) -> Optional[object]:
+    for pattern, funcs in spec.items():
+        if fnmatch.fnmatch(sf.rel, pattern):
+            return funcs
+    return None
+
+
+def _selected(funcs, name: str) -> bool:
+    if funcs == "*":
+        return True
+    return any(name == f or (f.endswith("_") and name.startswith(f))
+               for f in funcs)
+
+
+class _FnChecker:
+    def __init__(self, sf: SourceFile, fn: Union[ast.FunctionDef,
+                                                 ast.AsyncFunctionDef],
+                 qual: str, findings: list) -> None:
+        self.sf = sf
+        self.fn = fn
+        self.qual = qual
+        self.findings = findings
+        self.device_vars: set[str] = set()
+        self.loop_depth = 0
+
+    def _emit(self, check: str, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(
+            check, self.sf.rel, node.lineno, f"{self.qual}: {msg}",
+            end_line=getattr(node, "end_lineno", node.lineno)))
+
+    def _is_device_expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.device_vars
+        if isinstance(node, ast.Subscript):
+            return self._is_device_expr(node.value)
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name in _HOST_RETURNING:
+                return False
+            if name.startswith(_DEVICE_ROOTS):
+                return True
+            # compiled-program handles: self._unified_fn(...), self._verify_fn(...)
+            return name.startswith("self._") and name.endswith("_fn")
+        if isinstance(node, ast.Attribute):
+            return self._is_device_expr(node.value)
+        if isinstance(node, ast.BinOp):
+            return (self._is_device_expr(node.left)
+                    or self._is_device_expr(node.right))
+        return False
+
+    def check(self) -> None:
+        self._body(self.fn.body)
+
+    def _body(self, stmts) -> None:
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._expr(st.iter)
+            self._check_token_loop(st)
+            self.loop_depth += 1
+            self._body(st.body)
+            self._body(st.orelse)
+            self.loop_depth -= 1
+        elif isinstance(st, ast.While):
+            self._expr(st.test)
+            self.loop_depth += 1
+            self._body(st.body)
+            self._body(st.orelse)
+            self.loop_depth -= 1
+        elif isinstance(st, ast.If):
+            if self._is_device_expr(st.test):
+                self._emit("hot-implicit-bool", st.test,
+                           "branch on a device value forces a device->host "
+                           "sync; compare on host state or fold the branch "
+                           "into the compiled program")
+            self._expr(st.test)
+            self._body(st.body)
+            self._body(st.orelse)
+        elif isinstance(st, ast.Assign):
+            self._expr(st.value)
+            devicey = self._is_device_expr(st.value)
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name):
+                    (self.device_vars.add if devicey
+                     else self.device_vars.discard)(tgt.id)
+                elif isinstance(tgt, ast.Tuple) and devicey:
+                    for elt in tgt.elts:
+                        if isinstance(elt, ast.Name):
+                            self.device_vars.add(elt.id)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._body(st.body)  # nested helpers inherit hot-path rules
+        elif isinstance(st, ast.Try):
+            self._body(st.body)
+            for h in st.handlers:
+                self._body(h.body)
+            self._body(st.orelse)
+            self._body(st.finalbody)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._expr(item.context_expr)
+            self._body(st.body)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child)
+
+    def _check_token_loop(self, st) -> None:
+        it = st.iter
+        if not (isinstance(it, ast.Call)
+                and dotted_name(it.func) == "range" and it.args):
+            return
+        src = ast.dump(it.args[-1]).lower()
+        if "token" in src:
+            self._emit("hot-token-loop", st,
+                       "Python-level per-token loop — per-token work belongs "
+                       "inside the compiled program")
+
+    def _expr(self, node: Optional[ast.AST]) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        parts = name.split(".")
+        if name in ("jax.jit", "jax.pmap"):
+            if self.loop_depth > 0:
+                self._emit("hot-jit-in-loop", node,
+                           f"'{name}' inside a loop — a fresh compiled "
+                           f"callable per iteration (recompile storm)")
+            else:
+                self._emit("hot-jit-call", node,
+                           f"'{name}' in a hot function — per-request "
+                           f"tracing/compilation; build the program once at "
+                           f"startup")
+        elif parts[-1] in config.SYNC_CALL_ATTRS and len(parts) > 1:
+            self._emit("hot-host-sync", node,
+                       f"'.{parts[-1]}()' is a device->host sync")
+        elif name in config.SYNC_CALL_NAMES:
+            self._emit("hot-host-sync", node,
+                       f"'{name}' copies device memory to host")
+        elif name in ("float", "int", "bool") and node.args \
+                and self._is_device_expr(node.args[0]):
+            self._emit("hot-host-sync", node,
+                       f"'{name}()' on a device value is an implicit "
+                       f"device->host sync")
+        for a in node.args:
+            self._expr(a)
+        for kw in node.keywords:
+            self._expr(kw.value)
+
+
+def run(project: Project, hot_paths: Optional[dict] = None) -> list[Finding]:
+    spec = config.HOT_PATHS if hot_paths is None else hot_paths
+    findings: list[Finding] = []
+    for sf in project.files():
+        funcs = _hot_functions(spec, sf)
+        if funcs is None:
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and _selected(funcs, item.name):
+                    _FnChecker(sf, item, f"{node.name}.{item.name}",
+                               findings).check()
+        for item in sf.tree.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _selected(funcs, item.name):
+                _FnChecker(sf, item, item.name, findings).check()
+    return findings

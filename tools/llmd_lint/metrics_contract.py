@@ -1,0 +1,106 @@
+"""Metrics doc-contract analyzer (framework port of tools/lint_metrics.py —
+same checked contract, same sources of truth).
+
+The observability kit (grafana dashboards, alert rules, the promql cookbook)
+must only reference metric families the stack actually emits: the shared
+registry's declared families (expanded with histogram/summary series
+suffixes) plus raw-line providers found by scanning the source.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from pathlib import Path
+
+from .core import Finding, Project, REPO_ROOT
+
+# family-shaped names used across the stack (same pattern test_lint.py uses)
+METRIC_PAT = re.compile(
+    r"(llmd_tpu:[a-z_]+|llm_d_epp_[a-z_]+|igw_[a-z_]+|vllm:[a-z_]+"
+    r"|inference_objective_[a-z_]+)")
+
+
+def registry_families(root: Path = REPO_ROOT) -> set[str]:
+    """Every family name the shared registry declares, expanded with the
+    series suffixes histograms and summaries emit."""
+    sys.path.insert(0, str(root))
+    try:
+        from llmd_tpu.obs.metrics import (
+            Histogram,
+            Registry,
+            Summary,
+            register_engine_metrics,
+            register_engine_server_metrics,
+            register_pool_metrics,
+            register_router_metrics,
+        )
+    finally:
+        sys.path.remove(str(root))
+
+    reg = Registry()
+    register_engine_metrics(reg)
+    register_engine_server_metrics(reg)
+    register_router_metrics(reg)
+    register_pool_metrics(reg)
+    names: set[str] = set()
+    for name in reg.families():
+        names.add(name)
+        fam = reg.get(name)
+        if isinstance(fam, Histogram):
+            names |= {name + "_bucket", name + "_sum", name + "_count"}
+        elif isinstance(fam, Summary):
+            names |= {name + "_sum", name + "_count"}
+    return names
+
+
+def rawline_families(root: Path = REPO_ROOT) -> set[str]:
+    """Family names emitted as pre-rendered lines (plugin providers, sidecars)
+    anywhere in the source tree."""
+    names: set[str] = set()
+    for py in (root / "llmd_tpu").rglob("*.py"):
+        names |= set(METRIC_PAT.findall(py.read_text(errors="replace")))
+    return names
+
+
+def referenced(root: Path = REPO_ROOT) -> dict[str, list[str]]:
+    """Metric names referenced by the observability kit → referencing files."""
+    refs: dict[str, list[str]] = {}
+
+    def note(name: str, where: str) -> None:
+        refs.setdefault(name, []).append(where)
+
+    for dash in sorted((root / "observability" / "grafana").glob("*.json")):
+        doc = json.loads(dash.read_text())
+        for panel in doc.get("panels", []):
+            for tgt in panel.get("targets", []):
+                for m in METRIC_PAT.findall(tgt.get("expr", "")):
+                    note(m, f"grafana/{dash.name}")
+    alerts = root / "observability" / "alerts.yaml"
+    if alerts.exists():
+        for m in METRIC_PAT.findall(alerts.read_text()):
+            note(m, "alerts.yaml")
+    promql = root / "observability" / "promql.md"
+    if promql.exists():
+        for m in METRIC_PAT.findall(promql.read_text()):
+            note(m, "promql.md")
+    return refs
+
+
+def evaluate(emitted: set[str],
+             refs: dict[str, list[str]]) -> list[Finding]:
+    findings: list[Finding] = []
+    for name, where in sorted(refs.items()):
+        if name not in emitted:
+            findings.append(Finding(
+                "metrics-dangling-ref", "observability", 0,
+                f"{name}: referenced by {sorted(set(where))} but no registry "
+                f"family or raw-line provider emits it"))
+    return findings
+
+
+def run(project: Project) -> list[Finding]:
+    root = project.root
+    return evaluate(registry_families(root) | rawline_families(root),
+                    referenced(root))
