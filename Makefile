@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -41,6 +41,10 @@ structured:
 # autoscaling SLO gate: 10x burst + replica chaos, zero 5xx, warm 0->1
 slo:
 	JAX_PLATFORMS=cpu $(PY) tools/slo_check.py
+
+# device plane: watchdog, fabric probe, HBM gauges, profiler capture
+device-obs:
+	JAX_PLATFORMS=cpu $(PY) tools/device_obs_check.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

@@ -42,6 +42,12 @@ class AsyncLLMEngine:
 
     def _run(self) -> None:
         while not self._stop.is_set():
+            # heartbeat BEFORE taking the lock: a step wedged on the device
+            # holds the lock, so stamping inside it would mask the stall the
+            # watchdog (obs/device.py) exists to catch
+            mon = getattr(self.engine, "monitor", None)
+            if mon is not None:
+                mon.heartbeat()
             with self._lock:
                 has_work = self.engine.has_work()
                 outputs = self.engine.step() if has_work else []

@@ -23,6 +23,7 @@ continuous batching on accelerator'), built XLA-first:
 
 from __future__ import annotations
 
+import functools
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -62,6 +63,20 @@ from llmd_tpu.models.transformer import (
     unembed,
 )
 from llmd_tpu.parallel.mesh import build_mesh
+
+
+def _profile_phase(name: str):
+    """Wrap a step-loop phase in a ``jax.profiler.TraceAnnotation`` so an
+    on-demand capture (/debug/profile, obs/device.py) attributes host+device
+    time to the same phase names the step-duration histogram exports. The
+    annotation is a no-op TraceMe when no profiler session is active."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with jax.profiler.TraceAnnotation(name):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco
 
 
 @dataclass
@@ -182,6 +197,9 @@ class LLMEngine:
         # always-on per-request lifecycle timelines; EngineServer exposes
         # this recorder at /debug/requests (obs.events)
         self.flight = FlightRecorder.from_env(tracer=self.tracer)
+        # device-plane monitor (obs/device.py): attached by the owning
+        # EngineServer at start(); the dispatch loop stamps its heartbeat
+        self.monitor = None
         self.offload = None
         if engine_cfg.cpu_offload_pages > 0 or engine_cfg.offload_fs_path:
             from llmd_tpu.kv.fs_backend import FSKVBackend
@@ -1365,6 +1383,7 @@ class LLMEngine:
             and s.num_computed >= s.prompt_len
         ]
 
+    @_profile_phase("llmd.unified")
     def _step_unified(self) -> None:
         """Pack decode tokens + prefill chunks (across sequences) into the flat
         token budget and run ONE compiled step."""
@@ -1713,6 +1732,7 @@ class LLMEngine:
         self._step_spec_verify(plan)
         return True
 
+    @_profile_phase("llmd.spec_verify")
     def _step_spec_verify(self, plan: list[tuple[Sequence, list[int]]]) -> None:
         """Pack each sequence's draft as a short self-contained chunk (its
         last real token + the draft) through the verify program, accept the
@@ -1847,6 +1867,7 @@ class LLMEngine:
         while len(s.pages) > need:
             alloc.release(s.pages.pop())
 
+    @_profile_phase("llmd.decode_dispatch")
     def _decode_dispatch(self, active: list[Sequence], k: int, chain: Optional[dict],
                          wall_start: float, off: int = 0) -> dict:
         """Pack host state (+ the un-processed offset across ALL in-flight calls)
@@ -1930,6 +1951,7 @@ class LLMEngine:
         except Exception:  # noqa: BLE001 — observability must not take down serving
             self._attn_probe_fn = None
 
+    @_profile_phase("llmd.decode_process")
     def _decode_process(self, rec: dict) -> None:
         """Read one in-flight decode call's results and apply them to host state."""
         t1 = time.perf_counter()
@@ -2058,6 +2080,7 @@ class LLMEngine:
         self._free_seq(seq)
         self.seqs.pop(seq.request_id, None)
 
+    @_profile_phase("llmd.mask_build")
     def _build_bias(self, rows_and_seqs: list[tuple[int, "Sequence"]],
                     logits_shape: tuple) -> Optional[np.ndarray]:
         """Host-side additive ``[B, V]`` bias for a sample batch: the grammar

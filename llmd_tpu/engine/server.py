@@ -130,6 +130,11 @@ class EngineServer:
             self.async_engine = AsyncLLMEngine(self.engine)
         self._runner: Optional[web.AppRunner] = None
         self.request_count = 0
+        # Device-plane monitor (obs/device.py): created at start() by the
+        # server that owns the engine; wide-EP rank frontends share the
+        # engine's instance and only the creator stops it.
+        self.monitor = None
+        self._owns_monitor = False
         # graceful drain (POST /drain): admissions stop, in-flight requests
         # finish, /health reports draining so the router routes around us
         self._draining = False
@@ -213,6 +218,20 @@ class EngineServer:
 
     async def start(self) -> None:
         self.async_engine.start()
+        from llmd_tpu.obs.device import DeviceMonitor
+
+        mon = getattr(self.engine, "monitor", None)
+        if mon is None:
+            # pending_fn reads engine.seqs truthiness lock-free (GIL-atomic):
+            # the watchdog must never wait on the engine lock — a hung step()
+            # holds it, and that hang is exactly what it detects
+            mon = DeviceMonitor(
+                self.engine.registry, flight=self.engine.flight,
+                pending_fn=lambda: bool(self.engine.seqs))
+            self.engine.monitor = mon
+            mon.start()
+            self._owns_monitor = True
+        self.monitor = mon
         if self.kv_transfer_port is not None:
             from llmd_tpu.disagg.transfer import KVTransferClient, KVTransferSource
 
@@ -242,6 +261,7 @@ class EngineServer:
         app.router.add_get("/v1/conversations/{cid}/items", self._conv_list_items)
         app.router.add_get("/debug/requests", self._debug_requests)
         app.router.add_get("/debug/requests/{rid}", self._debug_request)
+        app.router.add_get("/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -262,6 +282,9 @@ class EngineServer:
             asyncio.get_running_loop().create_task(self._trace_flush_loop())
 
     async def stop(self) -> None:
+        if self._owns_monitor and self.monitor is not None:
+            self.monitor.stop()
+            self.engine.monitor = None
         self.async_engine.stop()
         if self.transfer_source is not None:
             self.transfer_source.stop()
@@ -837,6 +860,15 @@ class EngineServer:
             return web.json_response(
                 {"status": "draining", "inflight": len(self.engine.seqs)},
                 status=503)
+        mon = getattr(self.engine, "monitor", None)
+        reason = mon.unhealthy_reason() if mon is not None else None
+        if reason is not None:
+            # device fault (stalled step loop / dead fabric): same 503
+            # readiness semantics — the PoolController sweep retires us and
+            # the router's breakers route around us; the structured reason
+            # rides along so the retirement event says WHY
+            return web.json_response(
+                {"status": "unhealthy", **reason}, status=503)
         return web.json_response({"status": "ok"})
 
     async def _drain(self, request: web.Request):
@@ -884,6 +916,34 @@ class EngineServer:
         status, payload = debug_detail_response(
             self.engine.flight, request.match_info["rid"])
         return web.json_response(payload, status=status)
+
+    async def _debug_profile(self, request: web.Request):
+        """GET /debug/profile?seconds=N — capture one jax.profiler window
+        into LLMD_PROFILE_DIR and describe the artifact. One at a time (409
+        while busy); the capture blocks in an executor, not on the loop."""
+        from llmd_tpu.obs.device import ProfileBusy
+
+        mon = getattr(self.engine, "monitor", None)
+        if mon is None:
+            return web.json_response(
+                {"error": {"message": "device monitor not running"}},
+                status=503)
+        try:
+            seconds = float(request.query.get("seconds", "2"))
+        except ValueError:
+            return web.json_response(
+                {"error": {"message": "seconds must be numeric"}}, status=400)
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, mon.capture_profile, seconds)
+        except ProfileBusy as e:
+            return web.json_response(
+                {"error": {"message": str(e)}}, status=409)
+        except Exception as e:
+            return web.json_response(
+                {"error": {"message": f"profile capture failed: {e}"}},
+                status=500)
+        return web.json_response(result)
 
     async def _models(self, request: web.Request):
         data = [{"id": self.model_name, "object": "model"}]

@@ -53,6 +53,7 @@ annotations so dashboards can jump from a latency bucket to the trace.
 See observability/flight-recorder.md.
 """
 
+from llmd_tpu.obs.device import DeviceMonitor, fabric_alive_subprocess
 from llmd_tpu.obs.events import (
     EVENT_CATALOG,
     FlightRecorder,
@@ -65,6 +66,7 @@ from llmd_tpu.obs.metrics import (
     Registry,
     Summary,
     escape_label_value,
+    register_device_metrics,
     register_engine_metrics,
     register_engine_server_metrics,
     register_router_metrics,
@@ -79,6 +81,7 @@ from llmd_tpu.obs.tracing import (
 
 __all__ = [
     "Counter",
+    "DeviceMonitor",
     "EVENT_CATALOG",
     "FlightRecorder",
     "Gauge",
@@ -91,7 +94,9 @@ __all__ = [
     "TracingConfig",
     "escape_label_value",
     "extract_traceparent",
+    "fabric_alive_subprocess",
     "format_traceparent",
+    "register_device_metrics",
     "register_engine_metrics",
     "register_engine_server_metrics",
     "register_router_metrics",

@@ -80,6 +80,13 @@ EVENT_CATALOG = (
     "pool_scale_up",
     "pool_scale_down",
     "pool_warm_start",
+    # device plane (obs/device.py DeviceMonitor; system events — a hung TPU
+    # or wedged fabric has no owning request either)
+    "engine_stalled",
+    "engine_recovered",
+    "fabric_dead",
+    "fabric_recovered",
+    "profile_capture",
 )
 
 _TERMINAL_STATUS = {"finished", "aborted", "rejected", "error"}
@@ -216,8 +223,11 @@ class FlightRecorder:
     def snapshot(self, status: Optional[str] = None,
                  model: Optional[str] = None,
                  min_latency_ms: Optional[float] = None,
+                 trace_id: Optional[str] = None,
                  limit: int = 100) -> List[dict]:
-        """Newest-first summaries, filtered by status/model/min-latency."""
+        """Newest-first summaries, filtered by status/model/min-latency/
+        trace id (the trace filter is how a sampled span is correlated back
+        to its full flight timeline — see tools/dump_flight.py --trace)."""
         with self._lock:
             recs = list(self._records.values())
         out = []
@@ -225,6 +235,8 @@ class FlightRecorder:
             if status and rec.status != status:
                 continue
             if model and rec.model != model:
+                continue
+            if trace_id and rec.trace_id != trace_id:
                 continue
             if min_latency_ms is not None and rec.latency_s() * 1e3 < min_latency_ms:
                 continue
@@ -322,7 +334,7 @@ class FlightRecorder:
 
 def debug_list_response(flight: FlightRecorder, query) -> tuple:
     """``GET /debug/requests`` body: (http_status, payload). Query params:
-    ``status``, ``model``, ``min_latency_ms``, ``limit``."""
+    ``status``, ``model``, ``min_latency_ms``, ``trace``, ``limit``."""
     try:
         min_ms = (float(query["min_latency_ms"])
                   if "min_latency_ms" in query else None)
@@ -333,7 +345,9 @@ def debug_list_response(flight: FlightRecorder, query) -> tuple:
         "requests": flight.snapshot(
             status=query.get("status") or None,
             model=query.get("model") or None,
-            min_latency_ms=min_ms, limit=limit),
+            min_latency_ms=min_ms,
+            trace_id=query.get("trace") or None,
+            limit=limit),
         "system": flight.system_events(),
     }
 

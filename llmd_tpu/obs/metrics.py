@@ -41,9 +41,11 @@ __all__ = [
     "EngineMetrics",
     "EngineServerMetrics",
     "RouterMetrics",
+    "DeviceMetrics",
     "register_engine_metrics",
     "register_engine_server_metrics",
     "register_router_metrics",
+    "register_device_metrics",
 ]
 
 # Default latency buckets (seconds) — tuned for a TPU serving step loop
@@ -95,6 +97,8 @@ class _Family:
         self._lock = lock
         self._children: Dict[Tuple[str, ...], object] = {}
         self._fn: Optional[Callable[[], float]] = None
+        self._labels_fn: Optional[
+            Callable[[], Iterable[Tuple[Dict[str, object], float]]]] = None
 
     # -- child management -------------------------------------------------
     def labels(self, **kv):
@@ -121,6 +125,20 @@ class _Family:
             raise ValueError(f"{self.name}: set_function on labeled family")
         self._fn = fn
 
+    def set_labels_function(
+            self,
+            fn: Callable[[], Iterable[Tuple[Dict[str, object], float]]],
+    ) -> None:
+        """Attach a scrape-time callback yielding (labels-dict, value) pairs
+        for a *labeled* counter/gauge family — the per-device HBM gauges use
+        this so the exposed label sets track `jax.local_devices()` without
+        the monitor pre-registering a child per device."""
+        if not self.labelnames:
+            raise ValueError(
+                f"{self.name}: set_labels_function on unlabeled family; "
+                f"use set_function")
+        self._labels_fn = fn
+
     def _default(self):
         """The implicit child for unlabeled families."""
         if self.labelnames:
@@ -143,6 +161,13 @@ class _Family:
         captured one (an (labels-dict, value, unix-ts) triple)."""
         if self._fn is not None:
             yield "", "", float(self._fn()), None
+            return
+        if self._labels_fn is not None:
+            for labels, value in self._labels_fn():
+                if set(labels) != set(self.labelnames):
+                    continue  # malformed pair: skip rather than corrupt scrape
+                key = tuple(str(labels[n]) for n in self.labelnames)
+                yield "", _render_labels(self.labelnames, key), float(value), None
             return
         with self._lock:  # snapshot: .labels() can insert mid-scrape
             children = list(self._children.items())
@@ -686,6 +711,52 @@ class PoolMetricsFamilies:
                      60.0, 120.0))
 
 
+class DeviceMetrics:
+    """Families owned by DeviceMonitor (llmd_tpu/obs/device.py): HBM
+    telemetry, fabric liveness, the step watchdog, and profiler captures."""
+
+    def __init__(self, reg: Registry):
+        self.registry = reg
+        self.hbm_bytes_in_use = reg.gauge(
+            "llmd_tpu:device_hbm_bytes_in_use",
+            "HBM bytes currently allocated, per device "
+            "(absent on backends without memory_stats, e.g. CPU)",
+            labelnames=("device",))
+        self.hbm_peak_bytes = reg.gauge(
+            "llmd_tpu:device_hbm_peak_bytes",
+            "Peak HBM bytes allocated since process start, per device",
+            labelnames=("device",))
+        self.hbm_limit_bytes = reg.gauge(
+            "llmd_tpu:device_hbm_limit_bytes",
+            "HBM allocation limit, per device",
+            labelnames=("device",))
+        self.fabric_alive = reg.gauge(
+            "llmd_tpu:device_fabric_alive",
+            "1 while the fabric liveness probe completes within its timeout, "
+            "0 once a probe wedges or fails")
+        self.fabric_probe_failures = reg.counter(
+            "llmd_tpu:device_fabric_probe_failures_total",
+            "Fabric liveness probes that timed out or raised")
+        self.fabric_probe_seconds = reg.histogram(
+            "llmd_tpu:device_fabric_probe_seconds",
+            "Wall time of successful fabric liveness probes",
+            buckets=(0.001, 0.005, 0.025, 0.1, 0.5, 2.0, 10.0, 30.0))
+        self.engine_stalled = reg.gauge(
+            "llmd_tpu:engine_stalled",
+            "1 while the step watchdog sees pending work with no dispatch-"
+            "loop heartbeat for LLMD_WATCHDOG_STALL_S, else 0")
+        self.engine_stalls = reg.counter(
+            "llmd_tpu:engine_stalls_total",
+            "Stall episodes detected by the step watchdog")
+        self.heartbeat_age = reg.gauge(
+            "llmd_tpu:engine_heartbeat_age_seconds",
+            "Seconds since the engine dispatch loop last stamped its "
+            "heartbeat (scrape-time)")
+        self.profile_captures = reg.counter(
+            "llmd_tpu:profile_captures_total",
+            "On-demand jax.profiler windows captured via /debug/profile")
+
+
 def register_engine_metrics(reg: Registry) -> EngineMetrics:
     return EngineMetrics(reg)
 
@@ -700,3 +771,7 @@ def register_router_metrics(reg: Registry) -> RouterMetrics:
 
 def register_pool_metrics(reg: Registry) -> PoolMetricsFamilies:
     return PoolMetricsFamilies(reg)
+
+
+def register_device_metrics(reg: Registry) -> DeviceMetrics:
+    return DeviceMetrics(reg)

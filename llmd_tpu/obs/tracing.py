@@ -14,8 +14,10 @@ module implements the same surface in-process:
   root samples iff `trace_id mod 2^56 < ratio * 2^56` (deterministic per trace,
   like OTel's TraceIdRatioBased).
 - Export: `memory` (tests), `jsonl` (file, one OTLP-flavoured span per line),
-  `otlp` (HTTP POST of OTLP/JSON to `<endpoint>/v1/traces`, fire-and-forget in
-  a background thread), or `none`.
+  `otlp` (HTTP POST of OTLP/JSON to `<endpoint>/v1/traces`, fire-and-forget
+  through a single background worker draining a bounded queue — a slow or
+  absent collector drops spans and counts them in `spans_dropped` instead of
+  spawning a thread per span), or `none`.
 
 Env bootstrap mirrors the reference's knobs: `LLMD_OTEL_EXPORTER`,
 `LLMD_OTEL_ENDPOINT`, `LLMD_OTEL_SAMPLE_RATIO`, `OTEL_SERVICE_NAME`.
@@ -25,6 +27,7 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import random
 import threading
 import time
@@ -161,12 +164,20 @@ class TracingConfig:
 
 
 class Tracer:
+    # bound on spans waiting for the OTLP worker; past it spans are dropped
+    # (and counted) rather than buffered without limit
+    OTLP_QUEUE_MAX = 1024
+
     def __init__(self, cfg: Optional[TracingConfig] = None) -> None:
         self.cfg = cfg or TracingConfig()
         self.spans: list[Span] = []  # memory exporter sink
         self._lock = threading.Lock()
         self._jsonl_file = None
         self.export_errors = 0
+        self.spans_dropped = 0  # otlp queue overflow (guarded by _lock)
+        self._otlp_queue: "queue.Queue[Optional[Span]]" = queue.Queue(
+            maxsize=self.OTLP_QUEUE_MAX)
+        self._otlp_worker: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------- sampling
     def _sample_root(self, trace_id: str) -> bool:
@@ -223,7 +234,32 @@ class Tracer:
                 self.export_errors += 1
             return
         if mode == "otlp":
-            threading.Thread(target=self._post_otlp, args=(span,), daemon=True).start()
+            self._enqueue_otlp(span)
+
+    def _enqueue_otlp(self, span: Optional[Span]) -> None:
+        """Hand a span to the single OTLP worker (started lazily on first
+        export). One daemon thread per *tracer*, not per span: under load the
+        old per-span threads piled up behind a slow collector without bound.
+        A full queue drops the span and counts it — export is best-effort,
+        the serving path never blocks on the collector."""
+        with self._lock:
+            if self._otlp_worker is None:
+                self._otlp_worker = threading.Thread(
+                    target=self._otlp_drain, name="llmd-otlp-export",
+                    daemon=True)
+                self._otlp_worker.start()
+        try:
+            self._otlp_queue.put_nowait(span)
+        except queue.Full:
+            with self._lock:
+                self.spans_dropped += 1
+
+    def _otlp_drain(self) -> None:
+        while True:
+            span = self._otlp_queue.get()
+            if span is None:  # close() sentinel
+                return
+            self._post_otlp(span)
 
     def _post_otlp(self, span: Span) -> None:
         """Fire-and-forget OTLP/JSON POST (collector absent → counted, dropped)."""
@@ -251,6 +287,14 @@ class Tracer:
             if self._jsonl_file is not None:
                 self._jsonl_file.close()
                 self._jsonl_file = None
+            worker = self._otlp_worker
+            self._otlp_worker = None
+        if worker is not None:
+            try:
+                self._otlp_queue.put_nowait(None)  # wake + stop the drain
+            except queue.Full:
+                pass  # worker is far behind; daemon thread dies with us
+            worker.join(timeout=2.0)
 
 
 _GLOBAL: Optional[Tracer] = None

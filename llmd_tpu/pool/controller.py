@@ -423,23 +423,35 @@ class PoolController:
             return
         import aiohttp
 
-        async def probe(address: str) -> tuple[str, bool]:
+        async def probe(address: str) -> tuple[str, bool, str]:
+            """Returns (address, healthy, detail). A 5xx body's structured
+            reason (engine_stalled / fabric_dead from the device watchdog,
+            obs/device.py) rides along so the retirement event says WHY the
+            replica died, not just that it did."""
             try:
                 async with self._session.get(
                     f"http://{address}/health",
                     timeout=aiohttp.ClientTimeout(
                         total=self.cfg.health_timeout_s),
                 ) as r:
-                    return address, r.status < 500
+                    detail = ""
+                    if r.status >= 500:
+                        try:
+                            body = await r.json()
+                            detail = str(body.get("reason")
+                                         or body.get("status") or "")
+                        except Exception:
+                            detail = ""
+                    return address, r.status < 500, detail
             except Exception:
-                return address, False
+                return address, False, "unreachable"
 
         results = await asyncio.gather(*(probe(a) for a in list(self.replicas)))
-        dead = [a for a, ok in results if not ok]
+        dead = [(a, detail) for a, ok, detail in results if not ok]
         if not dead:
             return
         async with self._lock:
-            for address in dead:
+            for address, detail in dead:
                 handle = self.replicas.pop(address, None)
                 if handle is None:
                     continue
@@ -454,7 +466,8 @@ class PoolController:
                 if self.flight is not None:
                     self.flight.record_system(
                         "pool_scale_down", endpoint=address,
-                        reason="replica_dead", replicas=len(self.replicas))
+                        reason="replica_dead", detail=detail or "no_response",
+                        replicas=len(self.replicas))
             self.variant.current_replicas = len(self.replicas)
 
     # ---------------------------------------------------------------- status

@@ -1,0 +1,259 @@
+"""Device-plane observability (obs/device.py) against a real engine server.
+
+The acceptance path for the device plane: a synthetically wedged step loop
+must — within LLMD_WATCHDOG_STALL_S — produce the `engine_stalled` flight
+event, the stall metric, a 503 `/health` with a structured reason, and a
+PoolController health-sweep retirement carrying that reason; then recover
+when the loop resumes. Plus `/debug/profile` returning a non-empty
+jax.profiler artifact on CPU, the tracer's bounded OTLP queue, and the
+trace ↔ flight-timeline correlation (snapshot filter + dump_flight --trace).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import aiohttp
+
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.engine.config import EngineConfig
+from llmd_tpu.engine.server import EngineServer
+from llmd_tpu.models import get_model_config
+from llmd_tpu.obs.events import FlightRecorder
+from llmd_tpu.obs.tracing import Tracer, TracingConfig
+from llmd_tpu.pool.controller import PoolConfig, PoolController
+from llmd_tpu.pool.launcher import ReplicaHandle, ReplicaLauncher
+from tests.conftest import run_async
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+class _NullLauncher(ReplicaLauncher):
+    """Health-sweep-only stub: the test pre-registers its replica."""
+
+    async def launch(self):  # pragma: no cover - sweep never launches
+        raise NotImplementedError
+
+    async def kill(self, handle):
+        pass
+
+
+async def _wait_health(sess, base, want_status, timeout_s=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        async with sess.get(f"{base}/health") as r:
+            if r.status == want_status:
+                return await r.json()
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"/health never reached {want_status}")
+
+
+def test_stall_watchdog_503_pool_retirement_and_recovery(tmp_path, monkeypatch):
+    """Synthetic stall → watchdog trips within stall_s → flight event +
+    metric + structured 503 → health sweep retires the replica → heartbeat
+    resumes → recovery event and /health 200 again."""
+    monkeypatch.setenv("LLMD_WATCHDOG_STALL_S", "0.5")
+    monkeypatch.setenv("LLMD_FABRIC_PROBE_INTERVAL_S", "0")
+    monkeypatch.setenv("LLMD_PROFILE_DIR", str(tmp_path / "profiles"))
+
+    async def scenario():
+        server = EngineServer(
+            get_model_config("tiny"),
+            EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                         max_batch_size=4, prefill_chunk=32, decode_steps=2),
+            model_name="test/tiny", host="127.0.0.1", port=0, kv_events_port=0,
+        )
+        await server.start()
+        try:
+            base = f"http://{server.address}"
+            engine = server.engine
+            async with aiohttp.ClientSession() as sess:
+                await _wait_health(sess, base, 200)
+
+                # wedge the step loop: the engine thread blocks inside
+                # step() holding the engine lock — exactly what a hung
+                # device op looks like — while pending work exists
+                gate = threading.Event()
+                orig_step, orig_has_work = engine.step, engine.has_work
+
+                def _wedged_step():
+                    gate.wait()
+                    return []
+
+                engine.step = _wedged_step
+                engine.has_work = lambda: True
+                engine.seqs["synthetic-stall"] = object()
+
+                body = await _wait_health(sess, base, 503)
+                assert body["reason"] == "engine_stalled", body
+                assert body["heartbeat_age_s"] >= 0.5, body
+
+                async with sess.get(f"{base}/metrics") as r:
+                    text = await r.text()
+                assert "llmd_tpu:engine_stalled 1" in text
+                assert "llmd_tpu:engine_stalls_total 1" in text
+                events = [e["event"] for e in engine.flight.system_events()]
+                assert "engine_stalled" in events, events
+
+                # the pool controller's sweep sees the structured 503 and
+                # retires the replica with the watchdog's reason in tow
+                flight = FlightRecorder()
+                pool = EndpointPool()
+                pool.upsert(Endpoint(address=server.address))
+                ctl = PoolController(PoolConfig(min_replicas=0),
+                                     _NullLauncher(), pool=pool, flight=flight)
+                ctl.replicas[server.address] = ReplicaHandle(
+                    address=server.address)
+                ctl._session = aiohttp.ClientSession()
+                try:
+                    await ctl._health_sweep()
+                finally:
+                    await ctl._session.close()
+                assert server.address not in ctl.replicas
+                assert pool.list() == []
+                (retire,) = [e for e in flight.system_events()
+                             if e["event"] == "pool_scale_down"]
+                assert retire["reason"] == "replica_dead"
+                assert retire["detail"] == "engine_stalled"
+
+                # loop resumes → watchdog clears, health recovers
+                engine.step = orig_step
+                engine.has_work = orig_has_work
+                del engine.seqs["synthetic-stall"]
+                gate.set()
+                await _wait_health(sess, base, 200)
+                events = [e["event"] for e in engine.flight.system_events()]
+                assert "engine_recovered" in events, events
+        finally:
+            await server.stop()
+
+    run_async(scenario())
+
+
+def test_debug_profile_captures_artifact(tmp_path, monkeypatch):
+    """GET /debug/profile returns a non-empty jax.profiler capture on CPU,
+    taken while real decode work runs; bad seconds → 400."""
+    monkeypatch.setenv("LLMD_WATCHDOG_STALL_S", "0")
+    monkeypatch.setenv("LLMD_FABRIC_PROBE_INTERVAL_S", "0")
+    monkeypatch.setenv("LLMD_PROFILE_DIR", str(tmp_path / "profiles"))
+
+    async def scenario():
+        server = EngineServer(
+            get_model_config("tiny"),
+            EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                         max_batch_size=4, prefill_chunk=32, decode_steps=2),
+            model_name="test/tiny", host="127.0.0.1", port=0, kv_events_port=0,
+        )
+        await server.start()
+        try:
+            base = f"http://{server.address}"
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"{base}/debug/profile",
+                                    params={"seconds": "nope"}) as r:
+                    assert r.status == 400
+
+                # decode traffic inside the capture window so the annotated
+                # step phases have something to record
+                work = asyncio.ensure_future(sess.post(
+                    f"{base}/v1/completions", json={
+                        "prompt": "profile me while I decode some tokens",
+                        "max_tokens": 16, "temperature": 0.0,
+                        "ignore_eos": True,
+                    }))
+                async with sess.get(f"{base}/debug/profile",
+                                    params={"seconds": "0.5"}) as r:
+                    assert r.status == 200, await r.text()
+                    result = await r.json()
+                assert result["files"], result
+                assert result["bytes"] > 0, result
+                cap_dir = Path(result["dir"])
+                assert cap_dir.is_dir()
+                assert any((cap_dir / f).stat().st_size > 0
+                           for f in result["files"])
+                r2 = await work
+                assert r2.status == 200
+
+                async with sess.get(f"{base}/metrics") as r:
+                    assert "llmd_tpu:profile_captures_total 1" in await r.text()
+                events = [e["event"]
+                          for e in server.engine.flight.system_events()]
+                assert "profile_capture" in events, events
+        finally:
+            await server.stop()
+
+    run_async(scenario())
+
+
+def test_tracer_otlp_single_worker_bounded_queue():
+    """A slow collector no longer spawns a thread per span: one worker
+    drains a bounded queue and overflow increments spans_dropped."""
+    tracer = Tracer(TracingConfig(enabled=True, sample_ratio=1.0,
+                                  exporter="otlp",
+                                  otlp_endpoint="http://127.0.0.1:1"))
+    posted, block = [], threading.Event()
+
+    def _slow_post(span):
+        block.wait(5.0)
+        posted.append(span.name)
+
+    tracer._post_otlp = _slow_post
+    threads_before = threading.active_count()
+    n = tracer.OTLP_QUEUE_MAX + 50
+    for i in range(n):
+        with tracer.start_span(f"s{i}"):
+            pass
+    # one export worker, not one thread per span
+    assert threading.active_count() <= threads_before + 1
+    assert tracer.spans_dropped >= 49  # queue bound held (worker may hold 1)
+    assert tracer.spans_dropped < n  # but most spans made the queue
+    block.set()
+    tracer.close()
+
+
+def test_flight_snapshot_filters_by_trace():
+    flight = FlightRecorder()
+    flight.start("r1", model="m", trace_id="aaa0")
+    flight.record("r1", "arrival")
+    flight.start("r2", model="m", trace_id="bbb1")
+    flight.record("r2", "arrival")
+    flight.start("r3", model="m", trace_id="aaa0")  # two hops, one trace
+    flight.record("r3", "arrival")
+
+    got = flight.snapshot(trace_id="aaa0")
+    assert sorted(r["request_id"] for r in got) == ["r1", "r3"]
+    assert flight.snapshot(trace_id="zzzz") == []
+    assert len(flight.snapshot()) == 3  # no filter → everything
+
+
+def test_dump_flight_trace_offline(tmp_path):
+    """tools/dump_flight.py --trace renders every timeline on a trace from
+    an offline dump, and errors on an unknown id."""
+    flight = FlightRecorder()
+    flight.start("req-a", model="m", trace_id="cafe01")
+    flight.record("req-a", "arrival", path="/v1/completions")
+    flight.finish("req-a", reason="stop")
+    flight.start("req-b", model="m", trace_id="beef02")
+    flight.record("req-b", "arrival", path="/v1/completions")
+    dump = tmp_path / "flight.json"
+    dump.write_text(json.dumps(
+        {"requests": [flight.get("req-a"), flight.get("req-b")]}))
+
+    env = {**os.environ, "PYTHONPATH": str(ROOT)}
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "dump_flight.py"),
+         str(dump), "--trace", "cafe01"],
+        capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "trace cafe01: 1 request(s)" in proc.stdout
+    assert "req-a" in proc.stdout and "req-b" not in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "dump_flight.py"),
+         str(dump), "--trace", "nope"],
+        capture_output=True, text=True, env=env)
+    assert proc.returncode == 1

@@ -123,5 +123,6 @@ def test_ci_gate_composes_stages():
     assert summary["gate"] == "ok"
     assert [s["stage"] for s in summary["stages"]] == [
         "lint-envvars", "lint-metrics", "lint-events", "llmd-lint",
-        "validate-manifests", "chaos-check", "structured-check", "slo-check"]
+        "validate-manifests", "chaos-check", "structured-check", "slo-check",
+        "device-obs"]
     assert all(s["ok"] for s in summary["stages"])

@@ -66,6 +66,9 @@ def main() -> int:
         # closed autoscaling loop: 10x swing + replica kill/flap mid-burst,
         # SLO attainment >= 95%, zero 5xx, back to floor, warm 0->1 < cold
         ("slo-check", [py, "tools/slo_check.py"], CPU_ENV),
+        # device plane: watchdog trips on synthetic stall, fabric probe
+        # timeout path, HBM gauges scrape, profiler capture on CPU
+        ("device-obs", [py, "tools/device_obs_check.py"], CPU_ENV),
     ]
     if not args.skip_tests:
         pytest_cmd = [py, "-m", "pytest", "tests/", "-q"]

@@ -17,6 +17,10 @@ Usage:
   # one request's full timeline
   python tools/dump_flight.py http://localhost:8000 --id 1a2b3c...
 
+  # correlate a trace with its flight timeline(s): every request that
+  # carried this W3C trace id, rendered as full timelines
+  python tools/dump_flight.py http://localhost:8000 --trace 4bf92f35...
+
   # snapshot to a file, render offline later
   python tools/dump_flight.py http://localhost:8000 --save flight.json
   python tools/dump_flight.py flight.json
@@ -54,9 +58,25 @@ def _load(source: str, args: argparse.Namespace) -> dict:
             query["model"] = args.model
         if args.min_latency_ms is not None:
             query["min_latency_ms"] = str(args.min_latency_ms)
+        if args.trace:
+            query["trace"] = args.trace
         query["limit"] = str(args.limit)
         qs = urllib.parse.urlencode(query)
-        return _fetch(f"{base}/debug/requests?{qs}", args.timeout)
+        payload = _fetch(f"{base}/debug/requests?{qs}", args.timeout)
+        if args.trace:
+            # trace correlation renders full timelines: fetch each matching
+            # request's detail (summaries carry no events)
+            details = []
+            for r in payload.get("requests", []):
+                rid = r.get("request_id", "")
+                try:
+                    details.append(_fetch(
+                        f"{base}/debug/requests/{urllib.parse.quote(rid)}",
+                        args.timeout))
+                except Exception:
+                    details.append(r)  # evicted between list and detail
+            payload["requests"] = details
+        return payload
     with open(source) as f:
         data = json.load(f)
     if isinstance(data, dict) and "requests" in data:
@@ -111,6 +131,9 @@ def main(argv=None) -> int:
     ap.add_argument("source",
                     help="server base URL (http://host:port) or dump file")
     ap.add_argument("--id", help="render one request's full timeline")
+    ap.add_argument("--trace",
+                    help="render full timelines of every request carrying "
+                         "this trace id (trace ↔ timeline correlation)")
     ap.add_argument("--status",
                     help="filter: active|finished|aborted|rejected|error")
     ap.add_argument("--model", help="filter by model name")
@@ -140,6 +163,18 @@ def main(argv=None) -> int:
             print(f"error: request {args.id!r} not found", file=sys.stderr)
             return 1
         render_timeline(recs[0])
+    elif args.trace:
+        # offline dumps filter here; live payloads arrive pre-filtered (and
+        # already carry full timelines) — the filter is then a no-op
+        recs = [r for r in payload["requests"]
+                if r.get("trace_id") == args.trace]
+        if not recs:
+            print(f"error: no request carries trace {args.trace!r}",
+                  file=sys.stderr)
+            return 1
+        print(f"trace {args.trace}: {len(recs)} request(s)")
+        for rec in recs:
+            render_timeline(rec)
     else:
         render_list(payload)
     return 0

@@ -31,6 +31,7 @@ def registry_families(root: Path = REPO_ROOT) -> set[str]:
             Histogram,
             Registry,
             Summary,
+            register_device_metrics,
             register_engine_metrics,
             register_engine_server_metrics,
             register_pool_metrics,
@@ -44,6 +45,7 @@ def registry_families(root: Path = REPO_ROOT) -> set[str]:
     register_engine_server_metrics(reg)
     register_router_metrics(reg)
     register_pool_metrics(reg)
+    register_device_metrics(reg)
     names: set[str] = set()
     for name in reg.families():
         names.add(name)

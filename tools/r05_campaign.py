@@ -93,22 +93,17 @@ ATTN_TUNE_FILE = os.path.join(ROOT, "campaign_logs/attn_tune.json")
 
 
 def fabric_alive(timeout_s: float = 90.0) -> bool:
-    """Probe the TPU fabric in a throwaway subprocess (backend init is
-    process-fatal when the fabric is wedged, so it can't run in-process).
-
-    Much cheaper than bench.py's full preflight: no model build, no serve —
-    just backend init + device count. Used after a point times out to decide
+    """Probe the TPU fabric in a throwaway subprocess — the shared probe from
+    llmd_tpu.obs.device, so the bench harness and the serving DeviceMonitor
+    agree on what "fabric dead" means. Used after a point times out to decide
     between 'keep going' and 'fast-skip the rest with structured rows'.
     """
-    cmd = [sys.executable, "-c",
-           "import jax; print(len(jax.devices('tpu')))"]
-    try:
-        p = subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
-                           timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return False
-    return p.returncode == 0 and p.stdout.strip().isdigit() \
-        and int(p.stdout.strip()) > 0
+    if str(ROOT) not in sys.path:
+        sys.path.insert(0, str(ROOT))
+    from llmd_tpu.obs.device import fabric_alive_subprocess
+
+    return fabric_alive_subprocess(timeout_s=timeout_s, platform="tpu",
+                                   cwd=ROOT)
 
 
 def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
