@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -45,6 +45,10 @@ slo:
 # device plane: watchdog, fabric probe, HBM gauges, profiler capture
 device-obs:
 	JAX_PLATFORMS=cpu $(PY) tools/device_obs_check.py
+
+# global KV plane: precise routing + cross-engine pulls under churn, zero 5xx
+kvplane:
+	JAX_PLATFORMS=cpu $(PY) tools/kv_plane_check.py
 
 dryrun:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \

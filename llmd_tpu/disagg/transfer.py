@@ -94,10 +94,12 @@ class KVTransferParams:
 
     do_remote_decode: bool = False  # request to P: keep KV, return transfer handle
     do_remote_prefill: bool = False  # request to D: pull KV before compute
+    do_prefix_pull: bool = False  # KV-plane: pull a cached prefix from a peer engine
     remote_host: Optional[str] = None
     remote_port: Optional[int] = None
     remote_request_id: Optional[str] = None
     num_blocks: int = 0
+    block_hashes: list[int] = field(default_factory=list)  # prefix chain to pull
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "KVTransferParams":
@@ -105,14 +107,17 @@ class KVTransferParams:
         return cls(
             do_remote_decode=bool(d.get("do_remote_decode")),
             do_remote_prefill=bool(d.get("do_remote_prefill")),
+            do_prefix_pull=bool(d.get("do_prefix_pull")),
             remote_host=d.get("remote_host"),
             remote_port=d.get("remote_port"),
             remote_request_id=d.get("remote_request_id"),
             num_blocks=int(d.get("num_blocks", 0)),
+            block_hashes=[int(h) for h in d.get("block_hashes") or []],
         )
 
     def to_dict(self) -> dict:
-        return {k: v for k, v in self.__dict__.items() if v not in (None, False, 0)}
+        return {k: v for k, v in self.__dict__.items()
+                if v not in (None, False, 0) and v != []}
 
 
 # ---------------------------------------------------------------------------
@@ -153,12 +158,19 @@ class KVTransferSource:
     """Prefill-side export registry + TCP pull server.
 
     Protocol (shared by both transports):
-      request:  MAGIC ‖ u32 len ‖ JSON {"op": "pull"|"notify", "id": str}
+      request:  MAGIC ‖ u32 len ‖ JSON {"op": "pull"|"pull_prefix"|"notify",
+                                        "id": str, "hashes"?: [int]}
       response: u32 len ‖ JSON header ‖ payload[header["nbytes"]]
 
     ``transport``: "native" = C++ data plane (csrc/kv_transfer.cpp — serving runs off
     the GIL, the NIXL-role component), "python" = threaded sockets, "auto" = native
     with Python fallback.
+
+    ``prefix_provider`` (KV plane): optional callback
+    ``(block_hashes, request_id) -> Optional[(hashes, token_chunks, blocks)]``
+    that resolves an on-demand prefix export for a ``pull_prefix`` request. The
+    C++ transport does not speak this op, so under ``transport="auto"`` a set
+    provider selects the Python transport.
     """
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0, ttl_s: float = 120.0,
@@ -166,6 +178,7 @@ class KVTransferSource:
         self.host, self.port = host, port
         self.ttl_s = ttl_s  # outlives the sidecar idle window (tpu patch keep-alive 120s)
         self.transport = transport
+        self.prefix_provider = None  # set BEFORE start() to serve pull_prefix
         self.native = None  # (lib, handle) when the C++ server is live
         self.exports: dict[str, ExportedKV] = {}
         self._lock = threading.Lock()
@@ -222,7 +235,9 @@ class KVTransferSource:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
-        if self.transport in ("auto", "native") and self._start_native():
+        prefer_native = (self.transport == "native"
+                         or (self.transport == "auto" and self.prefix_provider is None))
+        if prefer_native and self._start_native():
             return
         if self.transport == "native":
             raise RuntimeError("native kv_transfer transport unavailable (g++ build failed)")
@@ -338,6 +353,33 @@ class KVTransferSource:
                                ex.block_shape, len(ex.payload))
             conn.sendall(struct.pack(">I", len(hdr)) + hdr)
             conn.sendall(ex.payload)
+        elif op == "pull_prefix":
+            provider = self.prefix_provider
+            hashes = [int(h) for h in req.get("hashes") or []]
+            res = None
+            if provider is not None and hashes:
+                try:
+                    res = provider(hashes, rid)
+                except Exception:
+                    res = None  # provider failure → miss; puller re-prefills
+            if res is None:
+                with self._lock:
+                    self._stats["misses"] += 1
+                hdr = json.dumps({"found": False, "nbytes": 0}).encode()
+                conn.sendall(struct.pack(">I", len(hdr)) + hdr)
+                return
+            got_hashes, chunks, blocks = res
+            # register under the PULLER's request id: the entry is freed by its
+            # notify (or abort-release/TTL) exactly like a P/D export, and is
+            # visible in len()/the transfer_registrations gauge meanwhile
+            self.register(rid, got_hashes, chunks, blocks)
+            with self._lock:
+                ex = self.exports[rid]
+                self._stats["pulls"] += 1
+            hdr = _pull_header(ex.block_hashes, ex.token_chunks, ex.dtype,
+                               ex.block_shape, len(ex.payload))
+            conn.sendall(struct.pack(">I", len(hdr)) + hdr)
+            conn.sendall(ex.payload)
         elif op == "notify":
             with self._lock:
                 self.exports.pop(rid, None)
@@ -373,14 +415,28 @@ class KVTransferClient:
             payload = _recv_exact(conn, hdr.get("nbytes", 0)) if hdr.get("nbytes") else b""
             return hdr, payload
 
-    def pull(self, host: str, port: int, request_id: str) -> Optional[PulledKV]:
-        hdr, payload = self._request(host, port, {"op": "pull", "id": request_id})
+    @staticmethod
+    def _decode(hdr: dict, payload: bytes) -> Optional[PulledKV]:
         if not hdr.get("found"):
             return None
         shape = tuple(hdr["block_shape"])
         n = len(hdr["block_hashes"])
         blocks = np.frombuffer(payload, dtype=np.dtype(hdr["dtype"])).reshape((n,) + shape)
         return PulledKV(hdr["block_hashes"], hdr["token_chunks"], blocks)
+
+    def pull(self, host: str, port: int, request_id: str) -> Optional[PulledKV]:
+        hdr, payload = self._request(host, port, {"op": "pull", "id": request_id})
+        return self._decode(hdr, payload)
+
+    def pull_prefix(self, host: str, port: int, request_id: str,
+                    block_hashes: Sequence[int]) -> Optional[PulledKV]:
+        """KV-plane pull: ask a peer engine for whatever prefix of the given
+        block-hash chain it still holds. One round trip — the peer resolves,
+        registers (under ``request_id``), and serves in the same response."""
+        hdr, payload = self._request(host, port, {
+            "op": "pull_prefix", "id": request_id,
+            "hashes": [int(h) for h in block_hashes]})
+        return self._decode(hdr, payload)
 
     def notify(self, host: str, port: int, request_id: str) -> bool:
         try:
@@ -475,6 +531,27 @@ def export_begin(engine, request_id: str, token_ids: list[int],
     return params, StagedExport(request_id, hashes, chunks, parts)
 
 
+def prefix_export_begin(engine, request_id: str, block_hashes: Sequence[int],
+                        staging_pages: int = 16) -> Optional[StagedExport]:
+    """Phase 1 of serving a cross-engine prefix pull (caller holds the engine
+    lock, cheap): walk the requested hash chain against the local prefix cache
+    and dispatch staged gathers for the resident prefix. The allocator retains
+    block hashes but not token chunks, so chunks ship empty — the puller
+    verifies the chain against its own prompt and fills chunks from it."""
+    pids: list[int] = []
+    hashes: list[int] = []
+    for h in block_hashes:
+        pid = engine.alloc.cached.get(int(h))
+        if pid is None:
+            break  # chain broken locally — serve the resident prefix only
+        pids.append(pid)
+        hashes.append(int(h))
+    if not pids:
+        return None
+    parts = stage_pages(engine.cache, pids, engine.cfg.num_pages, staging_pages)
+    return StagedExport(request_id, hashes, [[] for _ in hashes], parts)
+
+
 def export_finish(staged: StagedExport, source: KVTransferSource) -> int:
     """Phase 2 (engine lock NOT held): drain the staged copies into one
     contiguous block-major buffer and register the export. Returns blocks."""
@@ -541,6 +618,10 @@ def inject_into_engine(engine, pulled: PulledKV, token_ids: list[int],
     engine.cache = insert_blocks(engine.cache, pids, pulled.blocks[idxs], engine.cfg.num_pages)
     for i, pid in take:
         h = pulled.block_hashes[i]
-        engine.alloc.commit_block(pid, h, pulled.token_chunks[i], parent_of[h], lora_key)
+        # prefix pulls ship empty chunks (the peer's allocator doesn't retain
+        # them); the verified hash chain proves the local prompt slice is the
+        # exact token content of the block
+        chunk = list(pulled.token_chunks[i]) or token_ids[i * ps : (i + 1) * ps]
+        engine.alloc.commit_block(pid, h, chunk, parent_of[h], lora_key)
         engine.alloc.release(pid)  # refcount 0 → cached/evictable, like any prefix hit
     return len(take)

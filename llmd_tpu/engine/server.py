@@ -96,7 +96,13 @@ class EngineServer:
         self.advertise_host: Optional[str] = None  # routable host for transfer handles
         self.transfer_source = None
         self.transfer_client = None
-        self.transfer_stats = {"injected_blocks": 0, "pull_failures": 0}
+        self.transfer_stats = {"injected_blocks": 0, "pull_failures": 0,
+                               "prefix_pulls": 0, "prefix_pull_blocks": 0,
+                               "released": 0}
+        # KV-plane pulls whose peer-side registration may still be live:
+        # local rid → (host, port, remote_request_id). Released on request
+        # retire/abort so a dead puller never pins peer exports until TTL.
+        self._pending_pulls: dict[str, tuple] = {}
         self._zctx = None
         self._pub = None
         self._kv_seq = 0
@@ -161,13 +167,17 @@ class EngineServer:
         self.registry = Registry()
         self.server_metrics = register_engine_server_metrics(self.registry)
         self.server_metrics.requests.set_function(lambda: self.request_count)
-        for key in ("injected_blocks", "pull_failures"):
+        for key in ("injected_blocks", "pull_failures", "prefix_pulls",
+                    "prefix_pull_blocks", "released"):
             self.server_metrics.transfer[key].set_function(
                 lambda k=key: self.transfer_stats[k])
         for key in ("exports", "pulls", "notifies", "expired"):
             self.server_metrics.transfer[key].set_function(
                 lambda k=key: self.transfer_source.stats.get(k, 0)
                 if self.transfer_source is not None else 0)
+        self.server_metrics.transfer_registrations.set_function(
+            lambda: len(self.transfer_source)
+            if self.transfer_source is not None else 0)
 
     # -- KV events ---------------------------------------------------------
     def _on_kv_events(self, events: list[KVEvent]) -> None:
@@ -236,6 +246,15 @@ class EngineServer:
             from llmd_tpu.disagg.transfer import KVTransferClient, KVTransferSource
 
             self.transfer_source = KVTransferSource(port=self.kv_transfer_port)
+            from llmd_tpu.kvplane import plane_mode, serve_prefix
+
+            if plane_mode() == "precise":
+                # KV plane: serve peers' pull_prefix requests from the local
+                # prefix cache (set before start(): selects the python
+                # transport, which speaks the op; LLMD_KV_PLANE=off keeps the
+                # transfer source byte-identical to the pre-plane behavior)
+                self.transfer_source.prefix_provider = (
+                    lambda hashes, rid: serve_prefix(self, hashes, rid))
             self.transfer_source.start()
             self.kv_transfer_port = self.transfer_source.port
             self.transfer_client = KVTransferClient()
@@ -296,9 +315,13 @@ class EngineServer:
 
     # -- helpers -----------------------------------------------------------
     def _pull_remote_kv(self, ktp: "KVTransferParams", token_ids: list[int],
-                        lora_id=None, mm_hashes: list = ()) -> int:
+                        lora_id=None, mm_hashes: list = (),
+                        rid: Optional[str] = None) -> int:
         """Pull + inject remote prefill KV; any failure → recompute locally
         (kv_load_failure_policy=recompute, operations-vllm.md:84-100)."""
+        if rid is not None:
+            self._pending_pulls[rid] = (ktp.remote_host, ktp.remote_port,
+                                        ktp.remote_request_id)
         try:
             pulled = self.transfer_client.pull(
                 ktp.remote_host, ktp.remote_port, ktp.remote_request_id
@@ -312,7 +335,9 @@ class EngineServer:
             )
             self.transfer_stats["injected_blocks"] += n
             # free producer-side blocks (NIXL-notify semantics)
-            self.transfer_client.notify(ktp.remote_host, ktp.remote_port, ktp.remote_request_id)
+            if self.transfer_client.notify(ktp.remote_host, ktp.remote_port,
+                                           ktp.remote_request_id) and rid is not None:
+                self._pending_pulls.pop(rid, None)
             return n
         except Exception as e:
             self.transfer_stats["pull_failures"] += 1
@@ -325,6 +350,49 @@ class EngineServer:
                     self._shape_err_ts = now
                     print(f"kv-transfer: {e}", file=sys.stderr, flush=True)
             return 0
+
+    def _pull_prefix_kv(self, rid: str, ktp: "KVTransferParams",
+                        token_ids: list[int], lora_id=None,
+                        mm_hashes: list = ()) -> int:
+        """KV-plane prefix pull ahead of prefill. Any failure degrades to the
+        normal admission ladder (host/disk offload tier, then re-prefill) —
+        it NEVER fails the request. Injected blocks become ordinary local
+        prefix hits, so num_cached_prompt stays truthful for free."""
+        from llmd_tpu.kvplane import pull_prefix_into
+
+        self.transfer_stats["prefix_pulls"] += 1
+        self._pending_pulls[rid] = (ktp.remote_host, ktp.remote_port,
+                                    ktp.remote_request_id)
+        try:
+            n, outcome, released = pull_prefix_into(self, ktp, token_ids,
+                                                    lora_id, mm_hashes)
+        except Exception:
+            n, outcome, released = 0, "error", False
+        if released:
+            self._pending_pulls.pop(rid, None)
+        if n:
+            self.transfer_stats["prefix_pull_blocks"] += n
+        else:
+            self.transfer_stats["pull_failures"] += 1
+        # the pull runs before admission opens the flight record; start() is
+        # idempotent, so open it here and let add_request backfill the model
+        self.engine.flight.start(rid)
+        self.engine.flight.record(rid, "kv_pull", outcome=outcome, blocks=n,
+                                  peer=f"{ktp.remote_host}:{ktp.remote_port}")
+        return n
+
+    def _release_pending_pull(self, rid: str) -> None:
+        """Free the peer-side registration for a retired/aborted request
+        (satellite fix: a dead puller must not pin peer exports until TTL)."""
+        pending = self._pending_pulls.pop(rid, None)
+        if pending is None or self.transfer_client is None:
+            return
+        host, port, remote_rid = pending
+        try:
+            if self.transfer_client.notify(host, port, remote_rid):
+                self.transfer_stats["released"] += 1
+        except Exception:
+            pass  # peer gone; its TTL reaper cleans up
 
     def _tokenize_body(self, body: dict) -> list[int]:
         if body.get("prompt_token_ids"):
@@ -503,7 +571,18 @@ class EngineServer:
         if ktp.do_remote_prefill and self.transfer_client is not None:
             span.add_event("kv_transfer.pull")
             await asyncio.get_running_loop().run_in_executor(
-                None, self._pull_remote_kv, ktp, token_ids, lora_id, mm_hashes
+                None, self._pull_remote_kv, ktp, token_ids, lora_id, mm_hashes,
+                rid
+            )
+        elif (ktp.do_prefix_pull and ktp.block_hashes
+              and self.transfer_client is not None):
+            # KV plane: the router found this prefix cached on a peer — pull
+            # it before admission; failure falls through to the offload tier
+            # and then plain re-prefill
+            span.add_event("kv_plane.pull")
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._pull_prefix_kv, rid, ktp, token_ids, lora_id,
+                mm_hashes
             )
 
         try:
@@ -600,6 +679,13 @@ class EngineServer:
             span.set_error(str(e))
             return web.json_response({"error": {"message": str(e)}}, status=400)
         finally:
+            if rid in self._pending_pulls:
+                # retire/abort/disconnect with the peer registration still
+                # live (pull died between serve and notify): release it now.
+                # Not awaited — this finally also runs under task cancellation
+                # (client disconnect), where any await would re-raise.
+                asyncio.get_running_loop().run_in_executor(
+                    None, self._release_pending_pull, rid)
             span.end()  # idempotent backstop
 
     async def _embeddings(self, request: web.Request):

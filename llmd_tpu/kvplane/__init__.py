@@ -1,0 +1,28 @@
+"""Global KV plane: precise prefix-cache routing + cross-engine prefix pulls.
+
+Unites the pieces that existed in isolation — the event-fed block index
+(``llmd_tpu.kv.indexer``), the ZMQ event feed (``llmd_tpu.kv.subscriber``),
+the precise/approx router producers (``llmd_tpu.kv.plugins`` /
+``llmd_tpu.router.scorers``), and the P/D transfer wire
+(``llmd_tpu.disagg.transfer``) — into one operator-switchable subsystem
+(reference: precise-prefix-cache-routing/ + tiered-prefix-cache/):
+
+- ``llmd_tpu.kvplane.plane`` — ``KVPlane``: mode resolution from
+  ``LLMD_KV_PLANE`` (``precise`` | ``approx`` | ``off``), producer/scorer
+  swap on the live scheduler, per-request degradation to the approx LRU when
+  the index is cold/stale, and cross-engine pull planning (``plan_pull``).
+- ``llmd_tpu.kvplane.pull`` — engine-side halves: the ``prefix_provider``
+  serving a peer's ``pull_prefix`` and the puller that injects + credits the
+  local prefix cache (failure NEVER fails the request — the admission ladder
+  falls through to the host/disk offload tier, then plain re-prefill).
+"""
+
+from llmd_tpu.kvplane.plane import (  # noqa: F401
+    LABEL_KV_TRANSFER_ADDR,
+    LABEL_KV_TRANSFER_PORT,
+    STATE_KV_PLANE,
+    KVPlane,
+    KVPlaneProducer,
+    plane_mode,
+)
+from llmd_tpu.kvplane.pull import pull_prefix_into, serve_prefix  # noqa: F401

@@ -48,6 +48,7 @@ EVENT_CATALOG = (
     "flow_dispatch",
     "flow_reject",
     "routing_decision",
+    "kv_pull_stamped",
     "forward",
     "response",
     "rejected",
@@ -71,6 +72,7 @@ EVENT_CATALOG = (
     "preempted",
     "kv_reload",
     "kv_offload",
+    "kv_pull",
     "retired",
     "aborted",
     "drain_start",

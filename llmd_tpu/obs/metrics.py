@@ -598,8 +598,15 @@ class EngineServerMetrics:
                 f"llmd_tpu:kv_transfer_{key}_total",
                 f"Disaggregated KV transfer: {key}")
             for key in ("exports", "pulls", "notifies", "expired",
-                        "injected_blocks", "pull_failures")
+                        "injected_blocks", "pull_failures",
+                        "prefix_pulls", "prefix_pull_blocks", "released")
         }
+        # leak canary for the satellite fix: registrations a dead puller
+        # abandoned are released on retire (or reaped on TTL) — a standing
+        # non-zero value here under no traffic is a leak
+        self.transfer_registrations = reg.gauge(
+            "llmd_tpu:kv_transfer_registrations",
+            "Live KV export registrations held by the transfer source")
 
 
 class RouterMetrics:
@@ -685,6 +692,25 @@ class RouterMetrics:
         self.scrape_errors = reg.counter(
             "llm_d_epp_scrape_errors_total",
             "Endpoint metrics scrapes that failed (passive-health signal)")
+        # Global KV plane (llmd_tpu/kvplane, docs/kv-plane.md)
+        self.kvplane_precise = reg.counter(
+            "llm_d_epp_kv_plane_precise_total",
+            "Requests routed on precise event-fed index lookups")
+        self.kvplane_degraded = reg.counter(
+            "llm_d_epp_kv_plane_degraded_total",
+            "Requests degraded to the approx LRU (index cold or feed stale)")
+        self.kvplane_lookups = reg.counter(
+            "llm_d_epp_kv_plane_lookups_total",
+            "Precise index lookups performed by the KV plane")
+        self.kvplane_lookup_hits = reg.counter(
+            "llm_d_epp_kv_plane_lookup_hits_total",
+            "Precise lookups that found at least one indexed block")
+        self.kvplane_pulls_stamped = reg.counter(
+            "llm_d_epp_kv_plane_pulls_stamped_total",
+            "Cross-engine prefix pulls stamped onto forwarded requests")
+        self.kvplane_index_blocks = reg.gauge(
+            "llm_d_epp_kv_plane_index_blocks",
+            "Block-hash keys resident in the router's KV index")
 
 
 class PoolMetricsFamilies:

@@ -155,6 +155,14 @@ class RouterServer:
                 kv_cfg.get("indexBackend", "in-memory"),
                 **(kv_cfg.get("indexParams") or {}))
         self.scheduler = Scheduler(config, pool, self.ctx)
+        # Global KV plane (llmd_tpu/kvplane, docs/kv-plane.md): LLMD_KV_PLANE
+        # swaps prefix producers/scorers on the built scheduler and enables
+        # cross-engine pull stamping. "off" (the default) is a strict no-op —
+        # the config graph behaves bitwise-identically to a plane-less build.
+        from llmd_tpu.kvplane import KVPlane
+
+        self.kvplane = KVPlane.from_env(self.ctx, pool)
+        self.kvplane.install(self.scheduler)
         self.flow: Optional[FlowController] = (
             FlowController(config.flow_control, pool, self.ctx)
             if config.flow_control.enabled else None
@@ -168,7 +176,7 @@ class RouterServer:
         # a precise producer or an explicit kvEvents section (kv-indexer.md:67-87).
         self.kv_subscriber = None
         wants_precise = any(p.type == "precise-prefix-cache-producer" for p in config.plugins)
-        if wants_precise or (config.raw and "kvEvents" in config.raw):
+        if wants_precise or self.kvplane.active or (config.raw and "kvEvents" in config.raw):
             from llmd_tpu.kv.index_backends import build_index
             from llmd_tpu.kv.plugins import CTX_KV_INDEX
             from llmd_tpu.kv.subscriber import KVEventSubscriberManager
@@ -182,6 +190,7 @@ class RouterServer:
                 default_events_port=kv_cfg.get("port"),
                 bind_port=kv_cfg.get("bindPort"),
             )
+        self.kvplane.subscriber = self.kv_subscriber  # feed-staleness signal
         self.objectives = objectives or {}
         self.model_rewrites = model_rewrites or {}
         # Request parser (request-handling.md:73-75): openai-parser default;
@@ -255,13 +264,33 @@ class RouterServer:
             lambda: self.poller.scrape_error_count)
         self.metrics.breaker_open_endpoints.set_function(
             lambda: len(self.resilience.open_endpoints()))
+        plane = self.kvplane
+        self.metrics.kvplane_precise.set_function(
+            lambda: plane.stats["precise_requests"])
+        self.metrics.kvplane_degraded.set_function(
+            lambda: plane.stats["degraded_requests"])
+        self.metrics.kvplane_lookups.set_function(
+            lambda: plane.stats["lookups"])
+        self.metrics.kvplane_lookup_hits.set_function(
+            lambda: plane.stats["lookup_hits"])
+        self.metrics.kvplane_pulls_stamped.set_function(
+            lambda: plane.stats["pulls_planned"])
+        self.metrics.kvplane_index_blocks.set_function(
+            lambda: len(plane.index) if plane.index is not None else 0)
         # Discovery eviction: an endpoint leaving the pool (scale-down,
         # replica death) takes its breaker/draining/error-count state with
         # it — churned replicas must not leak state across scale cycles.
+        # The KV index evicts on the SAME listener: without this, a router
+        # whose subscriber isn't running against the departed pod (centralized
+        # mode, or no subscriber at all) keeps its blocks forever and the
+        # index grows unboundedly across controller churn.
         def _on_pool_event(kind: str, ep) -> None:
             if kind == "removed":
                 self.resilience.forget(ep.address)
                 self.poller.forget(ep.address)
+                idx = self.kvplane.index
+                if idx is not None:
+                    idx.remove_pod(ep.address)
 
         self._pool_listener = _on_pool_event
         pool.subscribe(self._pool_listener)
@@ -602,6 +631,30 @@ class RouterServer:
         return await self._forward_sticky(target, request.method, request.path,
                                           body, timeout_s=60)
 
+    def _stamp_kv_pull(self, req, target, body: dict) -> None:
+        """KV plane: when a peer engine holds materially more of this prompt's
+        prefix than the chosen target, stamp transfer params so the target
+        PULLS the prefix over the KV wire instead of re-prefilling it.
+        Re-invoked on every retry re-pick so the stamp tracks the target;
+        client-supplied kv_transfer_params (P/D flows) are never touched."""
+        if not self.kvplane.active:
+            return
+        stamped = bool(req.state.get("kv_plane_stamped"))
+        if body.get("kv_transfer_params") is not None and not stamped:
+            return  # client-owned transfer params — leave untouched
+        if stamped:
+            body.pop("kv_transfer_params", None)
+            req.state["kv_plane_stamped"] = False
+        plan = self.kvplane.plan_pull(req, target.address)
+        if plan is None:
+            return
+        peer = plan.pop("peer", None)
+        body["kv_transfer_params"] = plan
+        req.state["kv_plane_stamped"] = True
+        self.flight.record(req.request_id, "kv_pull_stamped",
+                           endpoint=target.address, peer=peer,
+                           blocks=len(plan.get("block_hashes") or ()))
+
     async def _handle_generate(self, request: web.Request):
         t_start = time.monotonic()
         self.metrics.requests.inc()
@@ -725,6 +778,7 @@ class RouterServer:
 
         target = result.endpoint
         prefill = result.prefill_endpoint
+        self._stamp_kv_pull(req, target, body)
         # Bounded retry loop: connect errors, attempt timeouts, and retryable
         # statuses (502/503/504) BEFORE any response body re-schedule on a
         # different endpoint (excluded set = llm-d excluded_runner_ids). Once
@@ -809,6 +863,7 @@ class RouterServer:
                     status=502)
             target = repick.endpoint
             prefill = repick.prefill_endpoint
+            self._stamp_kv_pull(req, target, body)  # re-plan for the new target
             excluded.add(target.address)
             attempt += 1
             span.set_attribute("llm_d.endpoint", target.address)

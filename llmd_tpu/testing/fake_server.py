@@ -103,6 +103,9 @@ class FakeModelServer:
         self._flap_t0 = time.monotonic()
         self.fault_counts = {"errors": 0, "refused": 0, "midstream": 0}
         self.draining = False  # POST /drain mirrors the engine server
+        # cross-engine prefix-pull simulation (docs/kv-plane.md)
+        self.pulls_completed = 0
+        self.pulled_blocks = 0
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -182,6 +185,44 @@ class FakeModelServer:
         self.blocks.clear()
         await self._publish([AllBlocksCleared()])
 
+    async def _simulate_prefix_pull(self, token_ids: list[int],
+                                    lora: Optional[str],
+                                    hashes: list) -> int:
+        """Adopt router-stamped pulled blocks ahead of admission. Only the
+        stamped hashes that agree with this prompt's own chain are adopted
+        (hash-chain verification, like ``inject_into_engine``); adopted
+        blocks then count as cached in ``_touch_blocks`` and are published
+        so the router index learns this pod now holds them."""
+        keys = block_keys_for_tokens(token_ids, self.cfg.block_size, lora)
+        n = 0
+        for k, h in zip(keys, hashes):
+            if int(h) != k:
+                break
+            n += 1
+        if not n:
+            return 0
+        cached = 0
+        for k in keys[:n]:
+            if k in self.blocks:
+                cached += 1
+            else:
+                break
+        now = time.monotonic()
+        for k in keys[:n]:
+            self.blocks[k] = now
+            self.blocks.move_to_end(k)
+        new_keys = keys[cached:n]
+        if new_keys:
+            await self._publish([BlockStored(
+                block_hashes=new_keys,
+                parent_block_hash=keys[cached - 1] if cached else None,
+                token_ids=token_ids[cached * self.cfg.block_size : n * self.cfg.block_size],
+                block_size=self.cfg.block_size, lora_id=lora,
+            )])
+        self.pulls_completed += 1
+        self.pulled_blocks += n
+        return n
+
     # -- fault injection ---------------------------------------------------
     def set_faults(self, **kw) -> None:
         """Update fault knobs at runtime (``set_faults(error_rate=0.2)``);
@@ -256,6 +297,10 @@ class FakeModelServer:
             self.queued -= 1
             self.running += 1
             try:
+                kv_params = body.get("kv_transfer_params") or {}
+                if kv_params.get("do_prefix_pull") and kv_params.get("block_hashes"):
+                    await self._simulate_prefix_pull(
+                        token_ids, lora, kv_params["block_hashes"])
                 cached = await self._touch_blocks(token_ids, lora)
                 uncached = max(0, len(token_ids) - cached)
                 prefill_s = (uncached * self.cfg.prefill_us_per_token / 1e6
@@ -263,7 +308,6 @@ class FakeModelServer:
                 tpot_s = (self.cfg.decode_us_per_token / 1e6
                           + self._injected_delay(self.faults.decode_delay_s))
                 # kv_transfer_params flow for P/D (disaggregation/README.md:104-131).
-                kv_params = body.get("kv_transfer_params") or {}
                 rid = f"cmpl-{uuid.uuid4().hex[:12]}"
                 model = body.get("model", self.cfg.model)
                 usage = {

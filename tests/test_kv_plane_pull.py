@@ -1,0 +1,368 @@
+"""Global KV plane: pull wire op, engine fallback ladder, registration
+release, and mode semantics (docs/kv-plane.md).
+
+The ladder requirement: a router-stamped cross-engine prefix pull may fail in
+any way (peer dead, peer evicted the blocks, inject rejected) and the request
+must still complete with output token-identical to a plane-less engine —
+failures only cost recompute, never correctness.
+"""
+
+import asyncio
+
+import aiohttp
+import numpy as np
+import pytest
+
+from llmd_tpu.core.config import FrameworkConfig
+from llmd_tpu.core.endpoint import Endpoint, EndpointPool
+from llmd_tpu.core.kv_events import block_keys_for_tokens
+from llmd_tpu.core.request import InferenceRequest
+from llmd_tpu.disagg.transfer import KVTransferClient, KVTransferSource
+from llmd_tpu.engine.config import EngineConfig
+from llmd_tpu.engine.server import EngineServer
+from llmd_tpu.kv.plugins import PrecisePrefixCacheScorer
+from llmd_tpu.kvplane import (
+    LABEL_KV_TRANSFER_PORT,
+    STATE_KV_PLANE,
+    KVPlane,
+    KVPlaneProducer,
+)
+from llmd_tpu.models import get_model_config
+from llmd_tpu.router import filters_pickers as _fp  # noqa: F401
+from llmd_tpu.router import scorers as _s  # noqa: F401
+from llmd_tpu.router.plugins import known_plugin_types
+from llmd_tpu.router.scorers import (
+    STATE_BLOCK_KEYS,
+    STATE_PREFIX_HITS,
+    ApproxPrefixCacheProducer,
+    PrefixCacheScorer,
+)
+from llmd_tpu.router.server import RouterServer
+from tests.conftest import run_async
+
+
+# ---------------------------------------------------------------- wire level
+def test_transfer_pull_prefix_wire():
+    """pull_prefix serves provider-staged blocks in one round trip and holds
+    the registration under the PULLER's id until notify."""
+    src = KVTransferSource(host="127.0.0.1")
+    blocks = np.arange(2 * 3 * 2 * 4 * 2 * 3, dtype=np.float32).reshape(2, 3, 2, 4, 2, 3)
+    asked = []
+
+    def provider(hashes, rid):
+        asked.append((list(hashes), rid))
+        if hashes[0] != 11:
+            return None
+        # engines ship empty chunks (the allocator keeps hashes, not tokens);
+        # the puller re-slices chunks from its own prompt
+        return [11, 22], [[], []], blocks
+
+    src.prefix_provider = provider  # before start(): forces python transport
+    src.start()
+    try:
+        assert src.native is None  # native transport doesn't speak pull_prefix
+        cli = KVTransferClient(timeout_s=5)
+        pulled = cli.pull_prefix("127.0.0.1", src.port, "puller-1", [11, 22, 33])
+        assert pulled is not None
+        assert pulled.block_hashes == [11, 22]
+        assert pulled.token_chunks == [[], []]
+        np.testing.assert_array_equal(pulled.blocks, blocks)
+        assert asked == [([11, 22, 33], "puller-1")]
+        # held under the puller's id until its notify, like a P/D export
+        assert len(src) == 1
+        assert cli.notify("127.0.0.1", src.port, "puller-1")
+        assert len(src) == 0
+        # provider miss → miss response, nothing registered
+        assert cli.pull_prefix("127.0.0.1", src.port, "puller-2", [99]) is None
+        assert len(src) == 0
+        assert src.stats["pulls"] == 1 and src.stats["misses"] == 1
+    finally:
+        src.stop()
+
+
+# ------------------------------------------------------------- engine ladder
+def _engine_cfg():
+    return EngineConfig(page_size=8, num_pages=64, max_model_len=256,
+                        max_batch_size=4, prefill_chunk=32)
+
+
+PROMPT_A = "the quick brown fox jumps over the lazy dog and keeps on running far"
+PROMPT_B = "pack my box with five dozen liquor jugs while the band plays on loud"
+PROMPT_C = "sphinx of black quartz judge my vow and then judge it one more time"
+
+
+def _hashes(prompt: str) -> list[int]:
+    return block_keys_for_tokens(list(prompt.encode()), 8)
+
+
+def _reusable(prompt: str) -> int:
+    """Tokens admission can reuse: full blocks minus the final-logit token."""
+    n_blocks = len(_hashes(prompt))
+    return min(n_blocks, (len(prompt.encode()) - 1) // 8) * 8
+
+
+async def _gen(sess, addr: str, prompt: str, ktp: dict = None) -> dict:
+    body = {"prompt": prompt, "max_tokens": 8, "temperature": 0.0,
+            "ignore_eos": True}
+    if ktp is not None:
+        body["kv_transfer_params"] = ktp
+    r = await sess.post(f"http://{addr}/v1/completions", json=body)
+    assert r.status == 200, await r.text()
+    return await r.json()
+
+
+def _pull_params(prompt: str, port: int, rid: str) -> dict:
+    return {"do_prefix_pull": True, "remote_host": "127.0.0.1",
+            "remote_port": port, "remote_request_id": rid,
+            "num_blocks": len(_hashes(prompt)), "block_hashes": _hashes(prompt)}
+
+
+def _flight_outcomes(server: EngineServer, rid: str) -> list[tuple]:
+    rec = server.engine.flight.get(rid) or {"events": []}
+    return [(e.get("outcome"), e.get("blocks")) for e in rec["events"]
+            if e["event"] == "kv_pull"]
+
+
+async def _ladder_scenario(monkeypatch):
+    monkeypatch.setenv("LLMD_KV_PLANE", "precise")
+    cfg = get_model_config("tiny")
+    peer = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                        port=0, kv_transfer_port=0)
+    target = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                          port=0, kv_transfer_port=0)
+    control = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                           port=0)
+    await peer.start()
+    await target.start()
+    await control.start()
+    try:
+        assert peer.transfer_source.prefix_provider is not None
+        async with aiohttp.ClientSession() as sess:
+            # ---- rung 1: peer holds the prefix → pull, token-identical ----
+            await _gen(sess, peer.address, PROMPT_A)  # warm the peer
+            expected = (await _gen(sess, control.address, PROMPT_A))["choices"][0]["text"]
+            got = await _gen(sess, target.address, PROMPT_A,
+                             _pull_params(PROMPT_A, peer.transfer_source.port, "plane-1"))
+            assert got["choices"][0]["text"] == expected
+            n_blocks = len(_hashes(PROMPT_A))
+            assert got["usage"]["cached_tokens"] == _reusable(PROMPT_A)
+            assert target.transfer_stats["prefix_pulls"] == 1
+            assert target.transfer_stats["prefix_pull_blocks"] == n_blocks
+            assert _flight_outcomes(target, got["id"]) == [("hit", n_blocks)]
+            # the peer-side registration was freed by the puller's notify
+            assert len(peer.transfer_source) == 0
+            assert peer.transfer_source.stats["notifies"] == 1
+
+            # ---- rung 2: peer dead → plain re-prefill, still correct ----
+            expected_b = (await _gen(sess, control.address, PROMPT_B))["choices"][0]["text"]
+            got = await _gen(sess, target.address, PROMPT_B,
+                             _pull_params(PROMPT_B, 1, "plane-2"))
+            assert got["choices"][0]["text"] == expected_b
+            assert got["usage"]["cached_tokens"] == 0
+            assert target.transfer_stats["pull_failures"] == 1
+            assert _flight_outcomes(target, got["id"]) == [("peer_dead", 0)]
+
+            # ---- rung 3: peer dead but local tier holds it → local hit ----
+            # (PROMPT_B is now resident on the target from rung 2: a failed
+            # pull must not disturb whatever the local cache tiers can serve)
+            got = await _gen(sess, target.address, PROMPT_B,
+                             _pull_params(PROMPT_B, 1, "plane-3"))
+            assert got["choices"][0]["text"] == expected_b
+            assert got["usage"]["cached_tokens"] == _reusable(PROMPT_B)
+            assert target.transfer_stats["pull_failures"] == 2
+
+            # ---- rung 4: peer alive but holds nothing → miss, re-prefill ----
+            expected_c = (await _gen(sess, control.address, PROMPT_C))["choices"][0]["text"]
+            got = await _gen(sess, target.address, PROMPT_C,
+                             _pull_params(PROMPT_C, peer.transfer_source.port, "plane-4"))
+            assert got["choices"][0]["text"] == expected_c
+            assert got["usage"]["cached_tokens"] == 0
+            assert _flight_outcomes(target, got["id"]) == [("miss", 0)]
+            assert len(peer.transfer_source) == 0  # a miss registers nothing
+
+            # registration gauge is exported on the peer
+            r = await sess.get(f"http://{peer.address}/metrics")
+            assert "llmd_tpu:kv_transfer_registrations 0" in await r.text()
+    finally:
+        await peer.stop()
+        await target.stop()
+        await control.stop()
+
+
+def test_kv_plane_pull_fallback_ladder(monkeypatch):
+    run_async(_ladder_scenario(monkeypatch))
+
+
+# ----------------------------------------------- registration release (abort)
+async def _release_scenario(monkeypatch):
+    """A puller whose notify fails (peer unreachable at that instant, crash
+    between serve and notify) must release the peer-side registration on
+    request retire instead of pinning it until TTL."""
+    monkeypatch.setenv("LLMD_KV_PLANE", "precise")
+    cfg = get_model_config("tiny")
+    peer = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                        port=0, kv_transfer_port=0)
+    target = EngineServer(cfg, _engine_cfg(), model_name="m", host="127.0.0.1",
+                          port=0, kv_transfer_port=0)
+    await peer.start()
+    await target.start()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            await _gen(sess, peer.address, PROMPT_A)
+            real_notify = target.transfer_client.notify
+            failed = []
+
+            def flaky_notify(host, port, rid):
+                if not failed:
+                    failed.append(rid)
+                    raise ConnectionError("injected: notify lost")
+                return real_notify(host, port, rid)
+
+            target.transfer_client.notify = flaky_notify
+            got = await _gen(sess, target.address, PROMPT_A,
+                             _pull_params(PROMPT_A, peer.transfer_source.port, "rel-1"))
+            assert got["usage"]["cached_tokens"] == _reusable(PROMPT_A)
+            assert failed == ["rel-1"]  # the in-band notify was the one lost
+            # retire-time release runs off-loop; the peer entry must drain
+            for _ in range(200):
+                if len(peer.transfer_source) == 0 and not target._pending_pulls:
+                    break
+                await asyncio.sleep(0.02)
+            assert len(peer.transfer_source) == 0
+            assert target._pending_pulls == {}
+            assert target.transfer_stats["released"] == 1
+    finally:
+        await peer.stop()
+        await target.stop()
+
+
+def test_abort_releases_peer_registration(monkeypatch):
+    run_async(_release_scenario(monkeypatch))
+
+
+# ------------------------------------------------------------ mode semantics
+APPROX_CFG = """
+plugins:
+  - {name: prefix, type: approx-prefix-cache-producer}
+  - {name: prefix-score, type: prefix-cache-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: prefix-score, weight: 1}
+"""
+
+PRECISE_CFG = """
+plugins:
+  - {name: prefix, type: precise-prefix-cache-producer}
+  - {name: prefix-score, type: precise-prefix-cache-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: prefix-score, weight: 1}
+"""
+
+
+def _router(cfg_yaml: str) -> RouterServer:
+    cfg = FrameworkConfig.from_yaml(cfg_yaml, known_types=known_plugin_types())
+    return RouterServer(cfg, EndpointPool(), port=0, poll_interval_s=3600)
+
+
+def test_plane_off_is_strict_noop(monkeypatch):
+    """LLMD_KV_PLANE unset: exact config-built plugin instances, no subscriber
+    beyond what the config asked for, no stamping."""
+    monkeypatch.delenv("LLMD_KV_PLANE", raising=False)
+    router = _router(APPROX_CFG)
+    assert not router.kvplane.active and router.kvplane.swaps == []
+    assert type(router.scheduler.plugins["prefix"]) is ApproxPrefixCacheProducer
+    assert type(router.scheduler.plugins["prefix-score"]) is PrefixCacheScorer
+    assert router.kv_subscriber is None
+    req = InferenceRequest(model="m", prompt="x" * 64)
+    body = {"prompt": "x" * 64}
+    router._stamp_kv_pull(req, Endpoint(address="10.0.0.1:80"), body)
+    assert "kv_transfer_params" not in body
+    assert "kv_plane_stamped" not in req.state
+    # explicitly-precise configs keep their instances too
+    precise = _router(PRECISE_CFG)
+    assert precise.kvplane.swaps == []
+    assert type(precise.scheduler.plugins["prefix-score"]) is PrecisePrefixCacheScorer
+
+
+def test_plane_precise_swaps_approx_pair(monkeypatch):
+    monkeypatch.setenv("LLMD_KV_PLANE", "precise")
+    router = _router(APPROX_CFG)
+    plugs = router.scheduler.plugins
+    assert isinstance(plugs["prefix"], KVPlaneProducer)
+    assert type(plugs["prefix-score"]) is PrecisePrefixCacheScorer
+    assert router.kv_subscriber is not None  # event feed forced on
+    # profile + producer lists were re-derived onto the swapped instances
+    assert plugs["prefix"] in router.scheduler.producers
+    prof = router.scheduler.profiles["default"]
+    assert any(p is plugs["prefix-score"] for p, _ in prof.scorers)
+
+
+def test_plane_approx_kill_switch(monkeypatch):
+    monkeypatch.setenv("LLMD_KV_PLANE", "approx")
+    router = _router(PRECISE_CFG)
+    plugs = router.scheduler.plugins
+    assert type(plugs["prefix"]) is ApproxPrefixCacheProducer
+    assert type(plugs["prefix-score"]) is PrefixCacheScorer
+    assert not router.kvplane.active  # and no pulls are ever planned
+    req = InferenceRequest(model="m", prompt="y" * 64)
+    req.state[STATE_KV_PLANE] = "precise"
+    assert router.kvplane.plan_pull(req, "10.0.0.1:80") is None
+
+
+# ------------------------------------------------------------ pull planning
+def test_plan_pull_threshold_and_side_channel():
+    pool = EndpointPool()
+    plane = KVPlane("precise", {}, pool, pull_threshold_blocks=2)
+    plane.block_size = 8
+    pool.upsert(Endpoint(address="10.0.0.9:8000",
+                         labels={LABEL_KV_TRANSFER_PORT: "7000"}))
+    req = InferenceRequest(model="m", prompt="z" * 64)
+    keys = list(range(100, 108))
+    req.state[STATE_KV_PLANE] = "precise"
+    req.state[STATE_BLOCK_KEYS] = keys
+    req.state[STATE_PREFIX_HITS] = {"10.0.0.9:8000": 48, "10.0.0.1:80": 8}
+    plan = plane.plan_pull(req, "10.0.0.1:80")
+    assert plan is not None
+    assert (plan["remote_host"], plan["remote_port"]) == ("10.0.0.9", 7000)
+    assert plan["block_hashes"] == keys[:6] and plan["num_blocks"] == 6
+    assert plan["peer"] == "10.0.0.9:8000"
+    assert plane.stats["pulls_planned"] == 1
+    # advantage below the threshold → no pull
+    req.state[STATE_PREFIX_HITS] = {"10.0.0.9:8000": 16, "10.0.0.1:80": 8}
+    assert plane.plan_pull(req, "10.0.0.1:80") is None
+    # degraded (LRU-backed) hits never trigger pulls
+    req.state[STATE_PREFIX_HITS] = {"10.0.0.9:8000": 48, "10.0.0.1:80": 8}
+    req.state[STATE_KV_PLANE] = "degraded"
+    assert plane.plan_pull(req, "10.0.0.1:80") is None
+    # peer without an advertised side channel → no pull
+    req.state[STATE_KV_PLANE] = "precise"
+    pool.upsert(Endpoint(address="10.0.0.9:8000"))  # labels gone
+    assert plane.plan_pull(req, "10.0.0.1:80") is None
+
+
+def test_kv_plane_producer_degrades_when_cold():
+    """Cold index → approx path + 'degraded' marker; warm → precise marker."""
+    from llmd_tpu.core.kv_events import BlockStored
+    from llmd_tpu.kv.indexer import KVBlockIndex
+    from llmd_tpu.kv.plugins import CTX_KV_INDEX
+
+    ctx = {}
+    pool = EndpointPool()
+    plane = KVPlane("precise", ctx, pool, stale_s=0)
+    prod = KVPlaneProducer(ctx, plane, blockSize=8)
+    eps = [Endpoint(address="10.0.0.1:80")]
+    req = InferenceRequest(model="m", prompt="w" * 64)
+    prod.produce(req, eps)
+    assert req.state[STATE_KV_PLANE] == "degraded"
+    assert plane.stats["degraded_requests"] == 1
+    # warm the index (any pod/block) → precise path
+    idx: KVBlockIndex = ctx[CTX_KV_INDEX]
+    idx.apply("10.0.0.1:80", BlockStored(block_hashes=[1], parent_block_hash=None,
+                                         token_ids=[0] * 8, block_size=8))
+    req2 = InferenceRequest(model="m", prompt="w" * 64)
+    prod.produce(req2, eps)
+    assert req2.state[STATE_KV_PLANE] == "precise"
+    assert plane.stats["precise_requests"] == 1 and plane.stats["lookups"] == 1

@@ -239,6 +239,45 @@ schedulingProfiles:
 """
 
 
+KV_ROUTER_CFG = ROUTER_CFG + """
+kvEvents:
+  bindPort: 0
+"""
+
+
+def test_kv_index_bounded_under_pool_churn():
+    """Centralized kvEvents mode (bindPort): the subscriber binds a socket and
+    never watches the pool, so the ROUTER's pool listener must evict departed
+    pods from the block index — same listener that forgets breaker/poller
+    state. Without it, kill/relaunch churn grows the index without bound."""
+
+    async def scenario():
+        from llmd_tpu.core.kv_events import BlockStored
+        from llmd_tpu.kv.plugins import CTX_KV_INDEX
+
+        pool = EndpointPool()
+        cfg = FrameworkConfig.from_yaml(KV_ROUTER_CFG,
+                                        known_types=known_plugin_types())
+        router = RouterServer(cfg, pool, port=0, poll_interval_s=3600)
+        await router.start()
+        try:
+            idx = router.ctx[CTX_KV_INDEX]
+            for i in range(50):  # kill/relaunch churn: add, publish, remove
+                addr = f"10.9.1.{i % 8}:{9100 + i}"
+                pool.upsert(Endpoint(address=addr))
+                idx.apply(addr, BlockStored(
+                    block_hashes=[i * 100 + j for j in range(10)],
+                    parent_block_hash=None, token_ids=[0] * 160,
+                    block_size=16))
+                assert len(idx) == 10
+                pool.remove(addr)
+                assert len(idx) == 0  # departure evicted the pod's blocks
+        finally:
+            await router.stop()
+
+    run_async(scenario())
+
+
 def test_router_forgets_departed_endpoints():
     async def scenario():
         pool = EndpointPool()

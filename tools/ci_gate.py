@@ -69,6 +69,9 @@ def main() -> int:
         # device plane: watchdog trips on synthetic stall, fabric probe
         # timeout path, HBM gauges scrape, profiler capture on CPU
         ("device-obs", [py, "tools/device_obs_check.py"], CPU_ENV),
+        # global KV plane: precise routing >= 90% prefix-served, cross-engine
+        # pull exercised, engine killed mid-run with zero 5xx, index bounded
+        ("kv-plane-check", [py, "tools/kv_plane_check.py"], CPU_ENV),
     ]
     if not args.skip_tests:
         pytest_cmd = [py, "-m", "pytest", "tests/", "-q"]

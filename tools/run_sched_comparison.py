@@ -21,6 +21,7 @@ import asyncio
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -38,14 +39,36 @@ schedulingProfiles:
       - {pluginRef: queue, weight: 2}
 """
 
+# KV-plane point: the config declares the APPROX pair so LLMD_KV_PLANE picks
+# the path at router start — "precise" swaps both plugins for the event-fed
+# plane versions, "approx" keeps them (the kill-switch baseline). Queue
+# outweighs prefix: idle engines tie on queue and prefix affinity decides, but
+# a loaded holder gets routed AROUND — approx re-prefills there, the precise
+# plane stamps a cross-engine pull instead (the measured difference).
+KV_PLANE_CFG = """
+plugins:
+  - {name: queue, type: queue-depth-scorer}
+  - {name: prefix, type: approx-prefix-cache-producer}
+  - {name: prefix-score, type: prefix-cache-scorer}
+schedulingProfiles:
+  - name: default
+    plugins:
+      - {pluginRef: queue, weight: 3}
+      - {pluginRef: prefix-score, weight: 1}
+"""
+
 
 class _Fixture:
     """N fake servers + RR proxy + EPP router (fresh per measurement so cache
     warmth never leaks between compared targets)."""
 
-    def __init__(self, servers: int, max_running: int = 8) -> None:
+    def __init__(self, servers: int, max_running: int = 8,
+                 cfg_yaml: str = ROUTER_CFG,
+                 transfer_label: bool = False) -> None:
         self.n = servers
         self.max_running = max_running
+        self.cfg_yaml = cfg_yaml
+        self.transfer_label = transfer_label
 
     async def __aenter__(self):
         # __aexit__ never runs when __aenter__ raises: a mid-startup failure
@@ -88,11 +111,14 @@ class _Fixture:
         await self.rr.start()
         pool = EndpointPool()
         for f in self.fakes:
-            pool.upsert(Endpoint(
-                address=f.address,
-                labels={LABEL_KV_EVENTS_ADDR: f"127.0.0.1:{f.cfg.kv_events_port}"},
-            ))
-        cfg = FrameworkConfig.from_yaml(ROUTER_CFG,
+            labels = {LABEL_KV_EVENTS_ADDR: f"127.0.0.1:{f.cfg.kv_events_port}"}
+            if self.transfer_label:
+                # advertise a KV side channel so the precise plane may stamp
+                # cross-engine pulls (fakes simulate the pull on receipt)
+                from llmd_tpu.kvplane import LABEL_KV_TRANSFER_PORT
+                labels[LABEL_KV_TRANSFER_PORT] = "7000"
+            pool.upsert(Endpoint(address=f.address, labels=labels))
+        cfg = FrameworkConfig.from_yaml(self.cfg_yaml,
                                         known_types=known_plugin_types())
         self.router = RouterServer(cfg, pool, port=0, poll_interval_s=0.2)
         await self.router.start()
@@ -144,6 +170,120 @@ async def run(servers: int, requests: int, concurrency: int) -> dict:
             spec, concurrency=concurrency,
         )
         report["fixture"] = fx.note
+    return report
+
+
+_KV_BLOCK = 16
+_KV_PREFIX_BLOCKS = 8  # 128 shared-prefix tokens, above the pull threshold (4)
+
+
+def _kv_prompt(g: int, r: int) -> str:
+    prefix = (f"group-{g:02d} " + "shared conversation context " * 20)
+    return prefix[: _KV_PREFIX_BLOCKS * _KV_BLOCK] + f" unique-{g}-{r}"
+
+
+async def _kv_plane_leg(mode: str, servers: int, groups: int,
+                        repeats: int) -> dict:
+    """One mode of the precise-vs-approx point: fresh 2-engine fixture,
+    shared-prefix repeats, per-request TTFT + recomputed-prefix tokens
+    (``prefix_tokens - cached_tokens``, clamped — the tokens an engine
+    re-prefilled because routing missed the prefix holder)."""
+    import aiohttp
+
+    prefix_tokens = _KV_PREFIX_BLOCKS * _KV_BLOCK
+    os.environ["LLMD_KV_PLANE"] = mode
+    os.environ["LLMD_KV_PLANE_STALE_S"] = "0"
+    async with _Fixture(servers, cfg_yaml=KV_PLANE_CFG,
+                        transfer_label=True) as fx:
+        ttfts: list[float] = []
+        recomputed = cached_total = errors = 0
+
+        async def post(sess, prompt):
+            t0 = time.monotonic()
+            async with sess.post(
+                f"http://{fx.router.address}/v1/completions",
+                json={"model": "fake/model", "prompt": prompt, "max_tokens": 8},
+            ) as r:
+                body = await r.json() if r.status == 200 else {}
+                return r.status, time.monotonic() - t0, body.get("usage") or {}
+
+        timeout = aiohttp.ClientTimeout(total=60)
+        async with aiohttp.ClientSession(timeout=timeout) as sess:
+            async def measure(g: int, r: int) -> None:
+                nonlocal recomputed, cached_total, errors
+                st, ttft, usage = await post(sess, _kv_prompt(g, r))
+                if st != 200:
+                    errors += 1
+                    return
+                ttfts.append(ttft)
+                cached = int(usage.get("cached_tokens", 0))
+                cached_total += cached
+                recomputed += max(0, prefix_tokens - min(cached, prefix_tokens))
+
+            for g in range(groups):  # warm round: first sight of each prefix
+                await post(sess, _kv_prompt(g, 0))
+            for r in range(1, repeats + 1):
+                for g in range(groups):
+                    await measure(g, r)
+
+            # disturbance: load one engine so the queue scorer routes its
+            # prefix groups to the other — approx re-prefills them there,
+            # precise pulls and credits the prefix as cached
+            fx.fakes[0].queued = 500
+            await asyncio.sleep(0.6)  # let the poller scrape the gauge
+            for r in range(repeats + 1, repeats + 4):
+                for g in range(groups):
+                    await measure(g, r)
+            fx.fakes[0].queued = 0
+
+        stats = dict(fx.router.kvplane.stats)
+        n = len(ttfts)
+        ratio = (round(stats["lookup_hits"] / stats["lookups"], 4)
+                 if stats.get("lookups") else None)
+        return {
+            "repeat_requests": n,
+            "errors": errors,
+            "ttft_mean_ms": round(sum(ttfts) / n * 1e3, 1) if n else None,
+            "ttft_p90_ms": (round(sorted(ttfts)[min(n - 1, int(0.9 * n))] * 1e3, 1)
+                            if n else None),
+            "recomputed_prefix_tokens": recomputed,
+            "recomputed_prefix_tokens_per_request": round(recomputed / n, 1) if n else None,
+            "cached_tokens_total": cached_total,
+            # artifact provenance: which plane path produced these numbers
+            "provenance": {"kv_plane": mode,
+                           "index_hash_hit_ratio": ratio,
+                           "plugin_swaps": fx.router.kvplane.swaps,
+                           "pulls_stamped": stats.get("pulls_planned", 0)},
+        }
+
+
+async def run_kv_plane_point(requests: int) -> dict:
+    """ISSUE 11 bench point: 2 engines, precise vs approx routing, recording
+    TTFT and recomputed-prefix-token counts. Fresh fixture per mode (no cache
+    inheritance); env restored afterwards so the point composes with the other
+    subcommands in one process."""
+    servers, groups = 2, 4
+    repeats = max(2, requests // (2 * groups))
+    prev = os.environ.get("LLMD_KV_PLANE")
+    try:
+        report = {"modes": {
+            mode: await _kv_plane_leg(mode, servers, groups, repeats)
+            for mode in ("approx", "precise")
+        }}
+    finally:
+        if prev is None:
+            os.environ.pop("LLMD_KV_PLANE", None)
+        else:
+            os.environ["LLMD_KV_PLANE"] = prev
+    a, p = report["modes"]["approx"], report["modes"]["precise"]
+    if a["recomputed_prefix_tokens"]:
+        report["delta"] = {
+            "precise_vs_approx_recomputed_prefix":
+                round(p["recomputed_prefix_tokens"] / a["recomputed_prefix_tokens"], 3),
+        }
+    report["fixture"] = {"servers": servers, "prefix_groups": groups,
+                         "repeats_per_group": repeats,
+                         "prefix_tokens": _KV_PREFIX_BLOCKS * _KV_BLOCK}
     return report
 
 
@@ -227,10 +367,15 @@ def main() -> None:
                     help="comma-separated QPS rungs: sweep the rate ladder over "
                          "BOTH workload profiles per target and report the "
                          "saturation knee (writes the matrix artifact)")
+    ap.add_argument("--kv-plane", action="store_true",
+                    help="2-engine precise-vs-approx KV-plane point: TTFT + "
+                         "recomputed-prefix-token counts per mode")
     args = ap.parse_args()
     if args.real_target:
         report = asyncio.run(run_real(*args.real_target, args.requests,
                                       args.concurrency))
+    elif args.kv_plane:
+        report = asyncio.run(run_kv_plane_point(args.requests))
     elif args.ladder:
         rates = [float(r) for r in args.ladder.split(",")]
         report = asyncio.run(run_ladder_matrix(args.servers, args.requests, rates))
@@ -239,7 +384,16 @@ def main() -> None:
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
-    if "profiles" in report:  # ladder matrix: print the knee summary
+    if "modes" in report:  # kv-plane point: per-mode summary
+        summary = {
+            m: {"ttft_mean_ms": d["ttft_mean_ms"],
+                "recomputed_prefix_tokens": d["recomputed_prefix_tokens"],
+                "index_hash_hit_ratio": d["provenance"]["index_hash_hit_ratio"]}
+            for m, d in report["modes"].items()
+        }
+        print(json.dumps({"out": args.out, **summary,
+                          **report.get("delta", {})}, indent=2))
+    elif "profiles" in report:  # ladder matrix: print the knee summary
         summary = {
             p: {t: {"knee_qps": d["knee_qps"],
                     "ttft_p90_ms_at_knee": d["ttft_p90_ms_at_knee"]}
