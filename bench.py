@@ -162,6 +162,27 @@ def main() -> None:
     ap.add_argument("--spec-tokens", type=int, default=None,
                     help="max draft tokens per sequence per verify step "
                          "(default: EngineConfig default)")
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=["auto", "pallas", "reference"],
+                    help="attention kernel selection (EngineConfig.attn_impl): "
+                         "auto = Pallas on TPU / XLA reference elsewhere; "
+                         "pallas forces the Pallas kernels (MLA decode takes "
+                         "the latent-width kernel); reference forces the XLA "
+                         "gather+mask path — the pallas-vs-xla A/B lever")
+    ap.add_argument("--pack-overlap", default="on", choices=["on", "off"],
+                    help="chained decode dispatches reuse the in-flight "
+                         "call's device-resident tokens/positions/kv-lens "
+                         "(EngineConfig.pack_overlap); off = legacy "
+                         "serialized full pack — the Lever 12 A/B")
+    ap.add_argument("--structured-fused", default="on", choices=["on", "off"],
+                    help="constrained rows ride the fused masked decode "
+                         "program (EngineConfig.structured_fused_decode); "
+                         "off = 1-token unified degrade — the Lever 12 "
+                         "structured A/B (pair with --workload json)")
+    ap.add_argument("--chain-depth", type=int, default=None,
+                    help="fused decode calls kept in flight per chain "
+                         "(EngineConfig.pipeline_depth; default: config "
+                         "default)")
     ap.add_argument("--workload", default="uniform",
                     choices=["uniform", "echo", "json"],
                     help="prompt distribution: uniform = distinct pseudo-random "
@@ -206,7 +227,9 @@ def main() -> None:
                 and os.environ.get("LLMD_LAYER_UNROLL") in (None, "", "1") \
                 and args.quantize == "default" and args.kv_dtype == "default" \
                 and args.kv_layout == "auto" and args.spec_mode == "off" \
-                and args.spec_tokens is None and args.workload == "uniform"
+                and args.spec_tokens is None and args.workload == "uniform" \
+                and args.attn_impl == "auto" and args.pack_overlap == "on" \
+                and args.structured_fused == "on" and args.chain_depth is None
             if flag_default:
                 try:
                     import glob as _glob
@@ -297,6 +320,14 @@ def main() -> None:
     eng_cfg.spec_mode = args.spec_mode
     if args.spec_tokens is not None:
         eng_cfg.spec_tokens = args.spec_tokens
+    chain_explicit = (args.attn_impl != "auto" or args.pack_overlap != "on"
+                      or args.structured_fused != "on"
+                      or args.chain_depth is not None)
+    eng_cfg.attn_impl = args.attn_impl
+    eng_cfg.pack_overlap = args.pack_overlap == "on"
+    eng_cfg.structured_fused_decode = args.structured_fused == "on"
+    if args.chain_depth is not None:
+        eng_cfg.pipeline_depth = max(1, args.chain_depth)
     # host↔device round-trip (PCIe locally; tens of ms through the dev tunnel) —
     # the latency the pipelined decode path exists to hide
     import jax.numpy as jnp
@@ -572,7 +603,7 @@ def main() -> None:
         # r03-proven shape and measure that instead
         if (tiny or args.batch or args.decode_steps or args.isl or args.osl
                 or args.layer_unroll or quantize_explicit or kv_explicit
-                or spec_explicit):
+                or spec_explicit or chain_explicit):
             # an explicitly requested shape or quantization must not silently
             # re-measure as something else (e.g. bf16 under an "int8" label)
             raise
@@ -644,10 +675,10 @@ def main() -> None:
           f"preemptions {st.total_preemptions})", file=sys.stderr)
     if st.structured_requests:
         print(f"# structured: {st.structured_requests} constrained requests, "
-              f"{st.structured_mask_builds} mask builds in "
-              f"{st.time_mask_build:.3f}s host "
-              f"({st.time_mask_build / max(1, st.structured_mask_builds) * 1e6:.0f}"
-              f" us/build), violations {st.structured_violations}",
+              f"{st.structured_mask_builds} mask builds + "
+              f"{st.structured_chain_stages} chain stages in "
+              f"{st.time_mask_build:.3f}s host, "
+              f"violations {st.structured_violations}",
               file=sys.stderr)
     if st.n_spec_verify_steps:
         print(f"# spec: drafted {st.spec_drafted}, accepted {st.spec_accepted}, "
@@ -657,7 +688,10 @@ def main() -> None:
     print(f"# phase split: prefill-steps {st.time_prefill_steps:.2f}s, "
           f"decode-steps {st.time_decode_steps:.2f}s, "
           f"spec-steps {st.time_spec_steps:.2f}s, launch-gap {launch_gap:.2f}s | "
-          f"host-pack {st.time_host_pack:.2f}s, device {st.time_device:.2f}s, "
+          f"host-pack {st.time_host_pack:.2f}s serialized "
+          f"(+{st.time_pack_overlap:.2f}s overlapped, "
+          f"{st.n_chained_dispatches} chained dispatches), "
+          f"device {st.time_device:.2f}s, "
           f"post {st.time_postprocess:.2f}s "
           f"({st.n_unified_steps} unified + {st.n_decode_calls} decode calls; "
           f"{dev_ms_per_decode:.1f} ms device/decode-call)", file=sys.stderr)
@@ -697,6 +731,17 @@ def main() -> None:
         "spec_steps_s": round(st.time_spec_steps, 3),
         "launch_gap_s": round(launch_gap, 3),
         "host_pack_s": round(st.time_host_pack, 3),
+        # Lever 12 (device-resident decode): pack wall hidden behind the
+        # in-flight chain, and the serialized per-step host total the lever
+        # shrinks (time_host_pack + time_mask_build) — A/B vs --pack-overlap
+        # off / --structured-fused off
+        "pack_overlap_s": round(st.time_pack_overlap, 3),
+        "chained_dispatches": st.n_chained_dispatches,
+        "serialized_host_s": round(st.time_host_pack + st.time_mask_build, 4),
+        "pack_overlap": eng_cfg.pack_overlap,
+        "structured_fused": eng_cfg.structured_fused_decode,
+        "chain_depth": eng_cfg.pipeline_depth,
+        "attn_impl": eng_cfg.attn_impl,
         "device_s": round(st.time_device, 3),
         "device_decode_s": round(st.time_device_decode, 3),
         "postprocess_s": round(st.time_postprocess, 3),
@@ -725,6 +770,7 @@ def main() -> None:
         # wall is the feature's per-step cost — compare against device_s
         "structured_requests": st.structured_requests,
         "structured_mask_builds": st.structured_mask_builds,
+        "structured_chain_stages": st.structured_chain_stages,
         "structured_violations": st.structured_violations,
         "mask_build_s": round(st.time_mask_build, 4),
     }))

@@ -137,6 +137,24 @@ class EngineConfig:
     # biased sampler; everything else keeps the exact unbiased programs),
     # "off" = reject structured requests at admission (ValueError -> 400).
     structured_mode: str = "auto"
+    # Device-resident decode steady state (PERF.md Lever 12). pack_overlap:
+    # while chain N runs on device, the host packs chain N+1 into rotated
+    # pre-staged buffers and reuses the in-flight chain's device-resident
+    # pos/lens/token outputs, so only the rows that actually changed cross
+    # the host->device boundary; the pack wall is accounted as
+    # time_pack_overlap (hidden behind device compute) instead of
+    # time_host_pack. False restores the legacy serialized pack + accounting.
+    pack_overlap: bool = True
+    # Constrained rows (grammar masks / logit_bias) ride the fused multi-step
+    # decode program with the bias apply + FSM transition done on device
+    # (structured/grammar.py dense_tables), instead of degrading the whole
+    # batch to 1-token unified steps. Rows combining a grammar AND a
+    # logit_bias, or tables past structured_table_max_elems, still degrade.
+    structured_fused_decode: bool = True
+    # Upper bound on the staged mask-table size (G_pad * S_pad * V elements,
+    # f32 bias + i32 next ~= 8 bytes/element). Past this, constrained rows
+    # fall back to the unified path rather than staging a huge table.
+    structured_table_max_elems: int = 1 << 23
 
     @property
     def max_pages_per_seq(self) -> int:

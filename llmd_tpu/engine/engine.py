@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import functools
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -139,6 +139,18 @@ class EngineStats:
     structured_mask_builds: int = 0
     structured_violations: int = 0
     time_mask_build: float = 0.0
+    # Device-resident decode steady state (PERF.md Lever 12): host pack wall
+    # that was hidden behind an in-flight device chain (a dispatch or process
+    # was pending when the pack ran) lands here instead of time_host_pack, so
+    # time_host_pack keeps meaning SERIALIZED host time on the critical path.
+    time_pack_overlap: float = 0.0
+    # dispatches that reused the in-flight chain's device-resident outputs
+    # (tokens/positions/kv-lens/FSM) instead of a full host re-pack
+    n_chained_dispatches: int = 0
+    # mask-table stagings for the fused constrained path (one per chain
+    # start, not one per step — the per-step host mask build this replaces
+    # is what time_mask_build used to count)
+    structured_chain_stages: int = 0
 
 
 class LLMEngine:
@@ -244,6 +256,19 @@ class LLMEngine:
         self._key = jax.random.PRNGKey(seed)
         self._outputs: list[EngineOutput] = []
         self._pending_decode: list[dict] = []  # in-flight pipelined decode calls
+        # Device-resident decode steady state (PERF.md Lever 12): rotated
+        # host-pack buffer sets — pipeline_depth+1 of them so the buffers a
+        # still-in-flight dispatch was packed from are never mutated while
+        # jnp.asarray may still alias them (the CPU backend zero-copies).
+        self._pack_bufs: list[dict[str, "np.ndarray"]] = []
+        # staged dense mask tables, LRU-keyed by the participating grammars'
+        # identities + pad shape; entries pin (bias_tab, next_tab) on device
+        self._mask_tab_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # spec probe arming: prompt-lookup drafting only re-probes after fresh
+        # tokens actually landed — a negative probe disarms until the next
+        # _decode_process/_sample_apply/verify landing, removing the redundant
+        # O(context) numpy scans the per-step probe used to pay mid-chain
+        self._spec_armed = True
         # one in-flight prefill-step sample read (pipelined like decode: the
         # ~RTT-priced np.asarray of the sampled tokens defers until the NEXT
         # unified step is on the device, hiding the read behind its compute)
@@ -480,12 +505,68 @@ class LLMEngine:
                 lens = jnp.where(act, lens + 1, lens)
                 return (cache, nxt, pos, lens, key), (nxt, cnt)
 
-            (cache, last_toks, _, _, _), (toks_out, cnts) = jax.lax.scan(
+            (cache, last_toks, pos_out, lens_out, _), (toks_out, cnts) = jax.lax.scan(
                 body, (cache, tokens, positions, kv_lens, key),
                 jnp.arange(k_steps, dtype=jnp.int32),
             )
-            # last_toks: device-resident chain point for the next pipelined call
-            return toks_out, last_toks, cache, cnts.sum(0)
+            # last_toks/pos_out/lens_out: device-resident chain point for the
+            # next pipelined call — a chained dispatch reuses them instead of
+            # re-packing positions and kv lens on the host
+            return toks_out, last_toks, pos_out, lens_out, cache, cnts.sum(0)
+
+        def _decode_multi_masked(params, cache, tokens, positions, page_tables,
+                                 kv_lens, temp, top_k, top_p, key, steps_left,
+                                 lora_idx, fsm_state, gidx, bias_tab, next_tab):
+            """``_decode_multi`` with the structured-outputs glue fused in:
+            per step, each row gathers its grammar's bias row at its current
+            FSM state from ``bias_tab [G, S, V]``, samples through the same
+            biased sampler the host path uses (f32 cast first — bitwise parity
+            with ``_sample_dispatch``), and advances its automaton through
+            ``next_tab [G, S, V] i32``. Slot 0 of both tables is the zero
+            no-op grammar, so unconstrained rows ride along unbiased.
+
+            The FSM state is part of the scan carry and of the return value:
+            a chained dispatch passes the previous call's ``fsm_out`` back in,
+            keeping the automaton device-resident for the whole chain. Frozen
+            rows (``steps_left`` spent) hold their state, mirroring the
+            host-side freeze in ``StructuredState.sync``.
+            """
+            tokens = _bind(tokens, "dp")
+            positions = _bind(positions, "dp")
+            page_tables = _bind(page_tables, "dp", None)
+            kv_lens = _bind(kv_lens, "dp")
+            seq_slots = jnp.arange(B, dtype=jnp.int32)
+            cu = jnp.arange(B + 1, dtype=jnp.int32)
+            ns = jnp.array([B], jnp.int32)
+
+            def body(carry, i):
+                cache, toks, pos, lens, key, st = carry
+                hidden, cache, cnt = forward_core(
+                    cfg, params, cache, toks, pos, seq_slots, page_tables, lens,
+                    cu_q_lens=cu, num_seqs=ns, attn_impl=attn_decode,
+                    moe_matmul_impl=moe_impl,
+                    lora_indices=lora_idx if use_lora else None,
+                    lora_scale=lora_scale,
+                )
+                logits = unembed(cfg, params, hidden).astype(jnp.float32)
+                row_bias = bias_tab[gidx, st]  # [B, vocab]
+                key, sub = jax.random.split(key)
+                nxt = sample_tokens_biased(logits, row_bias, sub, temp, top_k,
+                                           top_p)
+                new_st = next_tab[gidx, st, nxt]  # [B]
+                act = i < steps_left
+                st = jnp.where(act, new_st, st)
+                nxt = jnp.where(act, nxt, 0)
+                pos = jnp.where(act, pos + 1, pos)
+                lens = jnp.where(act, lens + 1, lens)
+                return (cache, nxt, pos, lens, key, st), (nxt, cnt)
+
+            (cache, last_toks, pos_out, lens_out, _, fsm_out), (toks_out, cnts) = (
+                jax.lax.scan(
+                    body, (cache, tokens, positions, kv_lens, key, fsm_state),
+                    jnp.arange(k_steps, dtype=jnp.int32),
+                ))
+            return toks_out, last_toks, pos_out, lens_out, fsm_out, cache, cnts.sum(0)
 
         def _embed(params, cache, tokens, positions, page_tables, kv_lens,
                    cu_q_lens, lora_idx):
@@ -509,6 +590,9 @@ class LLMEngine:
         # step, so spec_mode="off" engines never pay for it
         self._verify_fn = jax.jit(_make_verify(attn), **donate)
         self._decode_multi_fn = jax.jit(_decode_multi, **donate)
+        # lazy like _verify_fn: compiles on the first constrained fused
+        # dispatch, so unconstrained serving never pays for the masked program
+        self._decode_multi_masked_fn = jax.jit(_decode_multi_masked, **donate)
         self._embed_fn = jax.jit(_embed, **donate)
 
         # "attn" step-phase probe: a jitted attention-ONLY call at the live
@@ -1286,14 +1370,17 @@ class LLMEngine:
             # the mixed step reads host token state — apply any in-flight decode first
             self._flush_pending_decode()
             self._step_unified()
-        elif any(s is not None and (s.structured is not None or s.logit_bias)
-                 for s in self.running):
-            # Constrained rows (grammar mask / logit_bias) need the per-step
-            # host-built bias added before sampling; the fused decode program
-            # samples unbiased fully on-device, so batches carrying any
-            # constrained row decode through the unified step instead (it
-            # packs decode rows and samples via _sample_dispatch). Spec
-            # verify likewise never sees constrained rows.
+        elif (any(s is not None and (s.structured is not None or s.logit_bias)
+                  for s in self.running)
+              and self._constrained_needs_unified()):
+            # Constrained rows (grammar mask / logit_bias) normally ride the
+            # masked fused decode program — bias gather, biased sample, and
+            # FSM transition all on-device (_decode_multi_masked). The 1-token
+            # unified degrade (host-built bias + _sample_dispatch) remains for
+            # the cases the dense-table scheme can't express: the knob off, a
+            # row combining grammar AND logit_bias, or tables past the
+            # structured_table_max_elems gate. Spec verify never sees
+            # constrained rows either way (_spec_try_verify guards).
             self._flush_pending_decode()
             self._step_unified()
         else:
@@ -1626,6 +1713,14 @@ class LLMEngine:
         active = [s for s in active if s.slot >= 0]
         if not active:
             return
+        if (any(s.structured is not None or s.logit_bias for s in active)
+                and self._plan_chain_masks(active) is None):
+            # raced out of fused-mask eligibility (a preemption above changed
+            # the batch): degrade like the pool-pressure path rather than
+            # letting a constrained row decode unmasked
+            self._flush_pending_decode()
+            self._step_unified()
+            return
 
         if q:
             same = {(s.request_id, s.slot) for s in active} == {
@@ -1655,6 +1750,15 @@ class LLMEngine:
         q, self._pending_decode = self._pending_decode, []
         for rec in q:
             self._decode_process(rec)
+        if q:
+            # one event per chain teardown (the admission/retire boundary
+            # where the host re-enters the loop); a system event, not a
+            # per-request one — the chain is batch-scoped, and its lead row
+            # may have retired during this very drain (`retired` must stay
+            # the terminal event on every request timeline)
+            s, _slot = q[-1]["rows"][0]
+            self.flight.record_system("chain_retire", calls=len(q),
+                                      lead_request=s.request_id)
 
     # ------------------------------------------------------------ speculation
     def _spec_propose(self, s: Sequence, max_draft: int) -> list[int]:
@@ -1691,12 +1795,26 @@ class LLMEngine:
         active = self._decode_ready()
         if not active:
             return False
+        # The verify program samples unmasked greedy at every packed position:
+        # constrained rows must never ride it. Reachable now that constrained
+        # batches decode through the fused masked program instead of the
+        # unified degrade (which used to shadow this gate entirely).
+        if any(s.structured is not None or s.logit_bias for s in active):
+            return False
         # Greedy acceptance is only bitwise-equivalent to sequential decoding
         # for greedy rows; a batch with sampled sequences falls back to the
         # fused decode path.
         if any(s.sampling.temperature > 0.0 for s in active):
             return False
+        # Probe arming: the drafter is a pure function of each row's token
+        # history, so a no-match verdict stays valid until fresh tokens land
+        # (_decode_process / _sample_apply / a verify step re-arm). Skipping
+        # the re-probe drops the per-step O(context) numpy scans from the
+        # chained steady state.
+        if not self._spec_armed:
+            return False
         if not any(self._spec_propose(s, self.cfg.spec_tokens) for s in active):
+            self._spec_armed = False
             return False
         self._flush_pending_decode()
         active = [s for s in self._decode_ready() if s.slot >= 0]
@@ -1728,7 +1846,10 @@ class LLMEngine:
             spare[s.rank] -= len(draft)
         plan = [(s, d) for s, d in plan if s.slot >= 0]
         if not any(d for _, d in plan):
-            return False  # fresh state proposes nothing: plain decode instead
+            # fresh state proposes nothing: plain decode instead — and no
+            # re-probe until the next landing changes that state
+            self._spec_armed = False
+            return False
         self._step_spec_verify(plan)
         return True
 
@@ -1850,6 +1971,7 @@ class LLMEngine:
         st.n_spec_verify_steps += 1
         if n_tokens:
             self.metrics.decode_tokens.inc(n_tokens)
+            self._spec_armed = True  # fresh tokens landed: re-probe next step
         self.metrics.step_duration.labels(phase="spec_verify").observe(
             t3 - t0, exemplar=self._trace_exemplar([s for s, _, _, _ in rows]))
         self._emit_step_spans("spec_verify", [s for s, _, _, _ in rows], t0_ns,
@@ -1867,46 +1989,283 @@ class LLMEngine:
         while len(s.pages) > need:
             alloc.release(s.pages.pop())
 
+    # ------------------------------------------------- fused constrained decode
+    def _plan_chain_masks(self, active: list[Sequence]) -> Optional[dict]:
+        """Table-slot assignment + size gate for the fused masked decode
+        program. None = this batch's constrained rows cannot ride it and must
+        degrade to 1-token unified steps: the knob is off, a row combines a
+        grammar AND a logit_bias (two bias sources, one table slot), or the
+        padded tables would exceed structured_table_max_elems.
+
+        Tables are shared BY GRAMMAR, not by row — G is 1 (the zero no-op
+        grammar unconstrained rows index) + distinct grammars + one slot per
+        logit_bias row, so a batch of 64 rows sharing one JSON schema stages
+        one [2ᵖ, S_pad, V] pair, not 64.
+        """
+        if not self.cfg.structured_fused_decode:
+            return None
+        entries: list[tuple] = []  # table slot -1 -> ("g", grammar)|("b", items)
+        rows: list[tuple] = []  # (seq, table slot) for constrained rows
+        gram_slot: dict[int, int] = {}
+        key_parts: list[tuple] = []
+        smax = 1
+        for s in active:
+            has_g = s.structured is not None
+            has_b = bool(s.logit_bias)
+            if has_g and has_b:
+                return None
+            if has_g:
+                g = s.structured.grammar
+                gi = gram_slot.get(id(g))
+                if gi is None:
+                    gi = 1 + len(entries)
+                    gram_slot[id(g)] = gi
+                    entries.append(("g", g))
+                    smax = max(smax, g.n_states)
+                rows.append((s, gi))
+                key_parts.append((s.slot, "g", id(g)))
+            elif has_b:
+                items = tuple(sorted(s.logit_bias.items()))
+                gi = 1 + len(entries)
+                entries.append(("b", items))
+                rows.append((s, gi))
+                key_parts.append((s.slot, "b", items))
+        if not rows:
+            return None  # nothing constrained: the plain program serves it
+        def _pow2(n: int) -> int:
+            return 1 << (n - 1).bit_length()
+        G_pad, S_pad = _pow2(1 + len(entries)), _pow2(smax)
+        V = self.model_cfg.vocab_size
+        if G_pad * S_pad * V > self.cfg.structured_table_max_elems:
+            return None
+        return {"entries": entries, "rows": rows, "key": tuple(key_parts),
+                "G_pad": G_pad, "S_pad": S_pad, "V": V}
+
+    def _constrained_needs_unified(self) -> bool:
+        """step() routing: True when this step's constrained rows must take
+        the legacy unified degrade instead of the fused masked program."""
+        active = self._decode_ready()
+        if not any(s.structured is not None or s.logit_bias for s in active):
+            return False  # no constrained row is decode-ready this step
+        return self._plan_chain_masks(active) is None
+
+    @_profile_phase("llmd.chain_stage")
+    def _stage_chain_masks(self, active: list[Sequence]) -> Optional[dict]:
+        """Stage the dense bias/transition tables + per-row automaton entry
+        state for one fused masked chain. The [G_pad, S_pad, V] tables are
+        LRU-cached across chains (the cache entry pins its grammar objects,
+        so an id-keyed slot can never be reused by a different grammar while
+        staged), leaving only the fresh [B] FSM-entry vector per chain start.
+        The staging wall lands in time_mask_build — this is what replaces the
+        per-STEP host mask build that stat used to count."""
+        plan = self._plan_chain_masks(active)
+        if plan is None:
+            return None
+        t0 = time.perf_counter()
+        B = self.cfg.max_batch_size
+        G_pad, S_pad, V = plan["G_pad"], plan["S_pad"], plan["V"]
+        cache_key = (plan["key"], G_pad, S_pad)
+        hit = self._mask_tab_cache.get(cache_key)
+        if hit is not None:
+            self._mask_tab_cache.move_to_end(cache_key)
+            bias_dev, next_dev, gidx_dev, _pins = hit
+        else:
+            bias_tab = np.zeros((G_pad, S_pad, V), np.float32)
+            next_tab = np.zeros((G_pad, S_pad, V), np.int32)
+            pins = []
+            for gi, (kind, payload) in enumerate(plan["entries"], start=1):
+                if kind == "g":
+                    g = payload
+                    pins.append(g)
+                    b, nx = g.dense_tables()
+                    S = g.n_states
+                    bias_tab[gi, :S] = b
+                    next_tab[gi, :S] = nx
+                else:  # logit_bias row: state pinned at 0 (next stays 0)
+                    row = bias_tab[gi, 0]
+                    for tid, bval in payload:
+                        if 0 <= tid < V:
+                            # OpenAI semantics: -100 is an outright ban
+                            row[tid] = (NEG_BIAS if bval <= -100.0
+                                        else row[tid] + bval)
+            gidx = np.zeros((B,), np.int32)
+            for s, gi in plan["rows"]:
+                gidx[s.slot] = gi
+            bias_dev, next_dev = jnp.asarray(bias_tab), jnp.asarray(next_tab)
+            gidx_dev = jnp.asarray(gidx)
+            self._mask_tab_cache[cache_key] = (bias_dev, next_dev, gidx_dev,
+                                               tuple(pins))
+            while len(self._mask_tab_cache) > 8:
+                self._mask_tab_cache.popitem(last=False)
+        fsm0 = np.zeros((B,), np.int32)
+        for s, _gi in plan["rows"]:
+            stt = s.structured
+            if stt is None:
+                continue  # logit_bias row: enters (and stays) at state 0
+            fresh = stt.sync(s.token_ids, s.prompt_len)
+            if fresh:
+                self.stats.structured_violations += fresh
+                self.metrics.structured_violations.inc(fresh)
+            fsm0[s.slot] = stt.state
+            if not stt.mask_logged:
+                stt.mask_logged = True  # first mask only: timeline, not spam
+                self.flight.record(
+                    s.request_id, "structured_mask", kind=stt.kind,
+                    n_allowed=int(len(stt.grammar.allowed_ids(stt.state))))
+        dt = time.perf_counter() - t0
+        self.stats.time_mask_build += dt
+        self.stats.structured_chain_stages += 1
+        self.metrics.structured_mask_seconds.observe(dt)
+        self.metrics.step_duration.labels(phase="chain_stage").observe(dt)
+        return {"bias_tab": bias_dev, "next_tab": next_dev, "gidx": gidx_dev,
+                "fsm0": jnp.asarray(fsm0)}
+
+    def _pack_buf(self) -> dict[str, np.ndarray]:
+        """Rotated host-pack buffer set for the chained fast path. There are
+        pipeline_depth+1 sets, indexed by dispatch count: a set is never
+        refilled until the dispatch that uploaded from it has been processed
+        (the readback in ``_decode_process`` forces that computation), so the
+        CPU backend's zero-copy ``jnp.asarray`` aliasing can never observe a
+        mutation. Full packs (chain starts) use fresh arrays instead and need
+        no rotation — they are never mutated after upload."""
+        if not self._pack_bufs:
+            B = self.cfg.max_batch_size
+            self._pack_bufs = [
+                {"steps_left": np.zeros((B,), np.int32),
+                 "lens": np.ones((B,), np.int32)}
+                for _ in range(max(1, self.cfg.pipeline_depth) + 1)]
+        return self._pack_bufs[
+            self.stats.n_decode_dispatches % len(self._pack_bufs)]
+
     @_profile_phase("llmd.decode_dispatch")
     def _decode_dispatch(self, active: list[Sequence], k: int, chain: Optional[dict],
                          wall_start: float, off: int = 0) -> dict:
         """Pack host state (+ the un-processed offset across ALL in-flight calls)
         and launch one fused k-step decode chained on ``chain``'s device-resident
-        last tokens. Returns the in-flight record; results are NOT read."""
+        outputs. Returns the in-flight record; results are NOT read.
+
+        Two pack regimes (PERF.md Lever 12):
+
+        * chain start (or ``pack_overlap`` off): full host pack into fresh
+          arrays — the admission/retire boundary where the host owns the loop.
+        * chained fast path: the previous call's device-resident tokens,
+          positions, kv lens, and FSM states feed straight back in; the host
+          re-derives only ``steps_left`` (the per-row hard budget) and, when a
+          row grew a page, the page tables. One small upload instead of nine,
+          and the pack wall is overlapped with the in-flight device chain
+          (accounted as time_pack_overlap, not time_host_pack).
+        """
         B = self.cfg.max_batch_size
-        pos = np.full((B,), -1, np.int32)
-        pts = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
-        lens = np.ones((B,), np.int32)
-        lora_idx = np.zeros((B,), np.int32)
-        steps_left = np.zeros((B,), np.int32)
-        temp = np.zeros((B,), np.float32)
-        tk = np.zeros((B,), np.int32)
-        tp = np.ones((B,), np.float32)
-        toks = np.zeros((B,), np.int32)
-        for s in active:
-            i = s.slot
-            eff_len = len(s.token_ids) + off  # host view + in-flight tokens
-            toks[i] = s.token_ids[-1]  # unused when chaining (device tokens win)
-            pos[i] = eff_len - 1
-            pts[i, : len(s.pages)] = s.pages
-            lens[i] = eff_len
-            lora_idx[i] = self._lora_slot(s)
-            sp: SamplingParams = s.sampling
-            temp[i], tk[i], tp[i] = sp.temperature, sp.top_k, sp.top_p
-            gen = eff_len - s.prompt_len
-            steps_left[i] = max(0, min(s.max_tokens - gen,
-                                       self.cfg.max_model_len - eff_len, k))
+        fast = chain is not None and self.cfg.pack_overlap
+        if fast:
+            with jax.profiler.TraceAnnotation("llmd.pack_overlap"):
+                bufs = self._pack_buf()
+                steps_left, lens_np = bufs["steps_left"], bufs["lens"]
+                steps_left.fill(0)
+                sig = chain["pages_sig"]
+                pages_changed = False
+                for j, s in enumerate(active):
+                    i = s.slot
+                    eff_len = len(s.token_ids) + off  # host view + in-flight
+                    lens_np[i] = eff_len  # probe-only on this path (no upload)
+                    gen = eff_len - s.prompt_len
+                    steps_left[i] = max(0, min(s.max_tokens - gen,
+                                               self.cfg.max_model_len - eff_len,
+                                               k))
+                    if len(s.pages) != sig[j]:
+                        pages_changed = True
+                if pages_changed:
+                    pts_np = np.full((B, self.cfg.max_pages_per_seq), -1,
+                                     np.int32)
+                    for s in active:
+                        pts_np[s.slot, : len(s.pages)] = s.pages
+                    pts_dev = jnp.asarray(pts_np)
+                    pages_sig = tuple(len(s.pages) for s in active)
+                else:
+                    pts_np, pts_dev, pages_sig = (chain["pts_np"],
+                                                  chain["pts_dev"], sig)
+                toks_in, pos_in, lens_in = (chain["last_toks"],
+                                            chain["pos_out"],
+                                            chain["lens_out"])
+                temp_dev, tk_dev, tp_dev, lora_dev = (
+                    chain["temp_dev"], chain["tk_dev"], chain["tp_dev"],
+                    chain["lora_dev"])
+                steps_dev = jnp.asarray(steps_left)
+                mask = chain["mask"]
+                fsm_in = chain["fsm_out"]
+        else:
+            pos = np.full((B,), -1, np.int32)
+            pts_np = np.full((B, self.cfg.max_pages_per_seq), -1, np.int32)
+            lens_np = np.ones((B,), np.int32)
+            lora_idx = np.zeros((B,), np.int32)
+            steps_left = np.zeros((B,), np.int32)
+            temp = np.zeros((B,), np.float32)
+            tk = np.zeros((B,), np.int32)
+            tp = np.ones((B,), np.float32)
+            toks = np.zeros((B,), np.int32)
+            for s in active:
+                i = s.slot
+                eff_len = len(s.token_ids) + off  # host view + in-flight tokens
+                toks[i] = s.token_ids[-1]  # unused when chaining (device wins)
+                pos[i] = eff_len - 1
+                pts_np[i, : len(s.pages)] = s.pages
+                lens_np[i] = eff_len
+                lora_idx[i] = self._lora_slot(s)
+                sp: SamplingParams = s.sampling
+                temp[i], tk[i], tp[i] = sp.temperature, sp.top_k, sp.top_p
+                gen = eff_len - s.prompt_len
+                steps_left[i] = max(0, min(s.max_tokens - gen,
+                                           self.cfg.max_model_len - eff_len, k))
+            pages_sig = tuple(len(s.pages) for s in active)
+            pts_dev = jnp.asarray(pts_np)
+            pos_in, lens_in = jnp.asarray(pos), jnp.asarray(lens_np)
+            temp_dev, tk_dev, tp_dev = (jnp.asarray(temp), jnp.asarray(tk),
+                                        jnp.asarray(tp))
+            lora_dev = jnp.asarray(lora_idx)
+            steps_dev = jnp.asarray(steps_left)
+            toks_in = (chain["last_toks"] if chain is not None
+                       else jnp.asarray(toks))
+            if chain is not None:
+                mask, fsm_in = chain["mask"], chain["fsm_out"]
+            else:
+                mask = (self._stage_chain_masks(active)
+                        if any(s.structured is not None or s.logit_bias
+                               for s in active) else None)
+                fsm_in = mask["fsm0"] if mask is not None else None
+                for s in active:
+                    self.flight.record(s.request_id, "chain_dispatch", k=k,
+                                       masked=mask is not None)
         self._key, sub = jax.random.split(self._key)
-        toks_in = chain["last_toks"] if chain is not None else jnp.asarray(toks)
         t1 = time.perf_counter()
-        self.stats.time_host_pack += t1 - wall_start
-        toks_out, last_toks, self.cache, cnt = self._decode_multi_fn(
-            self._run_params(), self.cache, toks_in, jnp.asarray(pos),
-            jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(temp), jnp.asarray(tk),
-            jnp.asarray(tp), sub, jnp.asarray(steps_left), jnp.asarray(lora_idx),
-        )
+        if fast:
+            # the device is still executing chain N while this pack ran: its
+            # wall is hidden, not serialized — keep time_host_pack honest
+            self.stats.time_pack_overlap += t1 - wall_start
+            self.metrics.step_duration.labels(phase="pack_overlap").observe(
+                t1 - wall_start)
+        else:
+            self.stats.time_host_pack += t1 - wall_start
+            self.metrics.step_duration.labels(phase="pack").observe(
+                t1 - wall_start)
+        if mask is not None:
+            (toks_out, last_toks, pos_out, lens_out, fsm_out, self.cache,
+             cnt) = self._decode_multi_masked_fn(
+                self._run_params(), self.cache, toks_in, pos_in, pts_dev,
+                lens_in, temp_dev, tk_dev, tp_dev, sub, steps_dev, lora_dev,
+                fsm_in, mask["gidx"], mask["bias_tab"], mask["next_tab"],
+            )
+        else:
+            toks_out, last_toks, pos_out, lens_out, self.cache, cnt = (
+                self._decode_multi_fn(
+                    self._run_params(), self.cache, toks_in, pos_in, pts_dev,
+                    lens_in, temp_dev, tk_dev, tp_dev, sub, steps_dev,
+                    lora_dev,
+                ))
+            fsm_out = None
         self.stats.time_decode_steps += time.perf_counter() - wall_start
         self.stats.n_decode_dispatches += 1
+        if chain is not None:
+            self.stats.n_chained_dispatches += 1
         self.metrics.step_duration.labels(phase="decode_dispatch").observe(
             time.perf_counter() - wall_start,
             exemplar=self._trace_exemplar(active))
@@ -1915,7 +2274,7 @@ class LLMEngine:
         # never pay the probe's one-off compile
         if (self._attn_probe_fn is not None
                 and self.stats.n_decode_dispatches % self._attn_probe_every == 0):
-            self._observe_attn_phase(pts, lens, k)
+            self._observe_attn_phase(pts_np, lens_np, k)
         # Start the device->host copy of everything _decode_process will read.
         # Remote/tunneled runtimes defer execution until a result is demanded;
         # the async-copy hint makes the call run (and its tokens land on the
@@ -1929,6 +2288,11 @@ class LLMEngine:
         return {
             "rows": [(s, s.slot) for s in active],
             "toks_out": toks_out, "last_toks": last_toks, "cnt": cnt, "k": k,
+            # device-resident chain point for the next pipelined dispatch
+            "pos_out": pos_out, "lens_out": lens_out, "fsm_out": fsm_out,
+            "mask": mask, "pts_np": pts_np, "pts_dev": pts_dev,
+            "pages_sig": pages_sig, "temp_dev": temp_dev, "tk_dev": tk_dev,
+            "tp_dev": tp_dev, "lora_dev": lora_dev,
         }
 
     def _observe_attn_phase(self, pts: np.ndarray, lens: np.ndarray, k: int) -> None:
@@ -1977,6 +2341,14 @@ class LLMEngine:
                     break
             # the newest token's KV is never written yet → computed = len - 1
             s.num_computed = len(s.token_ids) - 1
+            if s.structured is not None:
+                # replay the landed tokens through the host automaton: keeps
+                # the cursor current for the next chain staging and counts
+                # violations (device-masked sampling should make fresh == 0)
+                fresh = s.structured.sync(s.token_ids, s.prompt_len)
+                if fresh:
+                    self.stats.structured_violations += fresh
+                    self.metrics.structured_violations.inc(fresh)
             if s.first_token_time is None:
                 s.first_token_time = now
                 self.flight.record(
@@ -2005,6 +2377,7 @@ class LLMEngine:
         st.n_decode_calls += 1
         if n_tokens:
             self.metrics.decode_tokens.inc(n_tokens)
+            self._spec_armed = True  # fresh tokens landed: re-probe the drafter
         self.metrics.step_duration.labels(phase="decode_process").observe(
             t3 - t1, exemplar=self._trace_exemplar([s for s, _ in rec["rows"]]))
         self._emit_step_spans("decode", [s for s, _ in rec["rows"]], t1_ns,
@@ -2166,6 +2539,7 @@ class LLMEngine:
                 continue  # aborted / preempted while the sample was in flight
             tok = int(sampled[i])
             s.token_ids.append(tok)
+            self._spec_armed = True  # fresh token landed: re-probe the drafter
             if s.structured is not None:
                 fresh = s.structured.sync(s.token_ids, s.prompt_len)
                 if fresh:  # masked sampling should make this unreachable
@@ -2257,4 +2631,13 @@ class LLMEngine:
         while self.has_work():
             for out in self.step():
                 done[out.request_id].extend(out.new_token_ids)
+        # quiesce invariant: every launched fused call was processed — a gap
+        # means a chained in-flight record was orphaned and its sampled
+        # tokens silently dropped (engine.py n_decode_dispatches docstring)
+        assert (self.stats.n_decode_dispatches == self.stats.n_decode_calls
+                and not self._pending_decode), (
+            f"decode pipeline leak at quiesce: dispatched="
+            f"{self.stats.n_decode_dispatches} "
+            f"processed={self.stats.n_decode_calls} "
+            f"pending={len(self._pending_decode)}")
         return done

@@ -82,6 +82,10 @@ def sample_tokens_biased(
 ) -> jax.Array:
     """`sample_tokens` with an additive logit bias applied ON DEVICE before
     argmax/sample — the grammar-mask / logit_bias path (llmd_tpu/structured).
+    Also inlined (jit-in-jit) by the fused masked decode program
+    (engine.py `_decode_multi_masked`), which gathers each row's bias from
+    the staged dense tables per scan step — same sampler, bitwise-identical
+    tokens whether the bias rides a unified step or a device chain.
     A separate jitted program so engines that never see a structured request
     never compile it (the spec.py lazy-jit pattern): `sample_tokens` keeps its
     exact HLO, and unbiased batches stay bitwise identical."""

@@ -84,6 +84,29 @@ def main() -> None:
                     help="structured outputs (llmd_tpu/structured): 'auto' = "
                          "compile grammars for requests that ask, 'off' = "
                          "reject structured requests as 400")
+    ap.add_argument("--decode-chain-depth", type=int,
+                    default=int(os.environ.get("LLMD_DECODE_CHAIN_DEPTH", "2")),
+                    help="fused decode calls kept in flight per chain "
+                         "(EngineConfig.pipeline_depth); deeper chains hide "
+                         "more host pack/readback wall behind device compute")
+    ap.add_argument("--pack-overlap",
+                    default=os.environ.get("LLMD_PACK_OVERLAP", "on"),
+                    choices=["on", "off"],
+                    help="chained dispatches reuse the in-flight call's "
+                         "device-resident tokens/positions/kv-lens and pack "
+                         "only changed rows, overlapped with device compute; "
+                         "'off' restores the serialized full pack")
+    ap.add_argument("--structured-fused",
+                    default=os.environ.get("LLMD_STRUCTURED_FUSED", "on"),
+                    choices=["on", "off"],
+                    help="constrained rows ride the fused masked decode "
+                         "program (on-device bias + FSM transition); 'off' "
+                         "degrades them to 1-token unified steps")
+    ap.add_argument("--structured-table-elems", type=int,
+                    default=int(os.environ.get("LLMD_STRUCTURED_TABLE_ELEMS",
+                                               str(1 << 23))),
+                    help="max staged mask-table size (G_pad*S_pad*V elements) "
+                         "before constrained rows degrade to unified steps")
     ap.add_argument("--enable-lora", action="store_true",
                     help="enable dynamic LoRA adapter serving")
     ap.add_argument("--max-loras", type=int, default=8)
@@ -143,6 +166,10 @@ def main() -> None:
         spec_mode=args.spec_mode, spec_tokens=args.spec_tokens,
         spec_ngram_max=args.spec_ngram_max, spec_ngram_min=args.spec_ngram_min,
         structured_mode=args.structured_mode,
+        pipeline_depth=max(1, args.decode_chain_depth),
+        pack_overlap=args.pack_overlap == "on",
+        structured_fused_decode=args.structured_fused == "on",
+        structured_table_max_elems=args.structured_table_elems,
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig

@@ -65,6 +65,8 @@ EVENT_CATALOG = (
     "prefill_end",
     "first_token",
     "decode",
+    "chain_dispatch",
+    "chain_retire",
     "spec_draft",
     "spec_verify",
     "structured_compile",

@@ -459,7 +459,10 @@ class EngineMetrics:
         self.step_duration = reg.histogram(
             "llmd_tpu:engine_step_duration_seconds",
             "Engine step wall time by phase "
-            "(unified, decode_dispatch, decode_process, spec_verify; attn = "
+            "(unified, decode_dispatch, decode_process, spec_verify; pack = "
+            "serialized host pack at a chain boundary, pack_overlap = chained "
+            "fast-path pack hidden behind the in-flight device call, "
+            "chain_stage = dense grammar/bias table staging per chain; attn = "
             "sampled attention-only probe scaled to the fused call: "
             "wall x layers x k)",
             labelnames=("phase",))

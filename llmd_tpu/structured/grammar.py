@@ -101,6 +101,33 @@ class TokenGrammar:
         row.fill(NEG_BIAS)
         row[self._allowed[state]] = 0.0
 
+    def dense_tables(self) -> tuple[np.ndarray, np.ndarray]:
+        """Whole-automaton dense form for the device-resident decode chain:
+        ``bias [S, V] f32`` (rows exactly as ``fill_bias`` would write them)
+        and ``nxt [S, V] i32`` (state transition per emitted token).
+
+        A violating token self-loops in ``nxt`` — the device freezes on the
+        same state the host-side ``StructuredState.sync`` freeze lands on, so
+        replaying the emitted tokens through ``advance`` reproduces the
+        device's trajectory bit-for-bit (that replay is still how violations
+        get counted). Cached on the grammar, which is itself LRU-cached.
+        """
+        cached = getattr(self, "_dense", None)
+        if cached is not None:
+            return cached
+        S, V = self.n_states, self.vocab_size
+        bias = np.full((S, V), NEG_BIAS, np.float32)
+        nxt = np.tile(np.arange(S, dtype=np.int32)[:, None], (1, V))
+        for s in range(S):
+            bias[s, self._allowed[s]] = 0.0
+        for s, row in enumerate(self._next):
+            for tid, st in row.items():
+                nxt[s, tid] = st
+            if s in self.accept:
+                nxt[s, self.eos_id] = self.terminal
+        self._dense = (bias, nxt)
+        return self._dense
+
 
 class StructuredState:
     """Per-sequence automaton cursor.

@@ -91,6 +91,17 @@ def main() -> int:
         # engine-loaded table hash matches the exported one
         stages.append(("bench-tiny-attn",
                        [py, "bench.py", "--tiny", "--cpu", "--tune-attn"], None))
+        # structured json workload smoke: the device-resident masked decode
+        # chain (dense-table staging, on-device FSM, pack-overlap dispatch)
+        # must survive a full tiny serve on CPU with zero violations
+        stages.append(("bench-tiny-structured",
+                       [py, "bench.py", "--tiny", "--cpu",
+                        "--workload", "json"], None))
+        # warm-start probe round trip on CPU: cold/warm child launches against
+        # one persistent compilation cache (the campaign's prog-override point)
+        stages.append(("bench-tiny-warmstart",
+                       [py, "tools/warm_start_probe.py", "--cpu",
+                        "--cache-dir", "campaign_logs/ci_warm_cache"], None))
     if not args.skip_dryrun:
         n = 2 if args.quick else 8
         stages.append((f"dryrun-multichip-{n}",

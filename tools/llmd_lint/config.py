@@ -31,6 +31,10 @@ HOT_PATHS: dict[str, object] = {
         "_flush_pending_",  # _flush_pending_decode/_flush_pending_sample
         "_sample_dispatch",
         "_sample_apply",
+        "_plan_chain_masks",
+        "_stage_chain_masks",
+        "_constrained_needs_unified",
+        "_pack_buf",
         "_spec_",          # propose/try_verify/release_tail
         "_build_bias",
         "_check_finish",

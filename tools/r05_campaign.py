@@ -37,8 +37,11 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Ordered by value-per-device-minute: windows close without warning, so the
 # headline configs re-measure first (the horizon-clamp dispatch fix makes all
-# pre-fix rows stale) and exploratory points run last.
-POINTS: list[tuple[str, list[str]]] = [
+# pre-fix rows stale) and exploratory points run last. An optional third
+# element overrides the program run for the point (default bench.py) — the
+# warm-start point runs tools/warm_start_probe.py, which speaks the same
+# one-JSON-line contract.
+POINTS: list[tuple] = [
     # serving default re-measure with pipelined prefill sampling (engine
     # default since the 2nd window): A/B against the harvested int8-b64 row
     # (4,042 tok/s), which pre-dates the deferred sample read
@@ -63,12 +66,34 @@ POINTS: list[tuple[str, list[str]]] = [
     ("int8-b64-spec-echo", ["--quantize", "int8", "--batch", "64",
                             "--spec-mode", "ngram", "--workload", "echo"]),
     # structured-outputs A/B vs the int8-b64 row: every request schema-
-    # constrained (response_format json_schema), so the point prices the
-    # grammar-mask path end to end — host mask builds + the biased sampler +
-    # the unified-step degrade (constrained rows can't ride fused decode).
-    # Like the spec echo row, excluded from best_serving (different workload).
+    # constrained (response_format json_schema). Since Lever 12, constrained
+    # rows ride the fused masked decode program (device-resident bias + FSM),
+    # so this point prices per-chain table staging + the masked chain; the
+    # -fused-off twin re-measures the legacy 1-token unified degrade for the
+    # A/B. Like the spec echo row, excluded from best_serving (different
+    # workload).
     ("int8-b64-structured", ["--quantize", "int8", "--batch", "64",
                              "--workload", "json"]),
+    ("int8-b64-structured-fused-off",
+     ["--quantize", "int8", "--batch", "64", "--workload", "json",
+      "--structured-fused", "off"]),
+    # Lever 12 pack-overlap A/B at the serving default: off restores the
+    # serialized full pack (and its time_host_pack accounting), so the pair's
+    # serialized_host_s delta is the lever's measured host-time win on-chip
+    ("int8-b64-packoff", ["--quantize", "int8", "--batch", "64",
+                          "--pack-overlap", "off"]),
+    # MLA latent-decode kernel A/B on the MoE-wide MLA registry shape
+    # (ops/mla_decode Pallas vs the absorbed XLA reference) — not
+    # best_serving-eligible (different model)
+    ("mla-decode-pallas", ["--model", "moe-wide-mla", "--quantize", "none",
+                           "--batch", "32", "--attn-impl", "pallas"]),
+    ("mla-decode-xla", ["--model", "moe-wide-mla", "--quantize", "none",
+                        "--batch", "32", "--attn-impl", "reference"]),
+    # real-replica warm start: cold vs warm relaunch against one persistent
+    # compilation cache (the pool controller's warm-start path), measured on
+    # the actual device. Prog override — runs the probe, not bench.py.
+    ("warm-start-replica", ["--model", "llama-1b"],
+     "tools/warm_start_probe.py"),
     ("int8-b64-unroll4", ["--quantize", "int8", "--batch", "64",
                           "--layer-unroll", "4"]),
     ("int8-b64-unroll16", ["--quantize", "int8", "--batch", "64",
@@ -106,8 +131,9 @@ def fabric_alive(timeout_s: float = 90.0) -> bool:
                                    cwd=ROOT)
 
 
-def run_point(name: str, extra: list[str], timeout_s: float) -> dict:
-    cmd = [sys.executable, os.path.join(ROOT, "bench.py")] + extra
+def run_point(name: str, extra: list[str], timeout_s: float,
+              prog: str = "bench.py") -> dict:
+    cmd = [sys.executable, os.path.join(ROOT, prog)] + extra
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
     t0 = time.monotonic()
     # stream stderr (bench.py's phase trace) to a per-point log so a
@@ -157,7 +183,7 @@ def main() -> None:
     ap.add_argument("--timeout", type=float, default=1500.0)
     args = ap.parse_args()
     skip = set(filter(None, args.skip.split(",")))
-    known = {n for n, _ in POINTS}
+    known = {p[0] for p in POINTS}
     for s in skip - known:
         print(f"# WARNING: --skip name {s!r} matches no point "
               f"(known: {', '.join(sorted(known))})", file=sys.stderr)
@@ -177,13 +203,15 @@ def main() -> None:
             print(f"# merging into {len(prior)} prior point(s) from {args.out}",
                   file=sys.stderr)
 
-    points = [(n, e) for n, e in POINTS if n not in skip]
+    points = [p for p in POINTS if p[0] not in skip]
     if not points:
         print(json.dumps({"error": "every point skipped"}))
         return
     results: list[dict] = []
     dead_after: "str | None" = None  # point whose timeout found the fabric dead
-    for name, extra in points:
+    for entry in points:
+        name, extra = entry[0], entry[1]
+        prog = entry[2] if len(entry) > 2 else "bench.py"
         if dead_after is not None:
             # fabric confirmed dead: structured skip, same shape as bench.py's
             # own preflight skip rows, but issued here in ~0s instead of after
@@ -192,7 +220,7 @@ def main() -> None:
                             "note": f"fabric dead (probe failed after "
                                     f"{dead_after!r} timed out)"})
         else:
-            row = run_point(name, extra, args.timeout)
+            row = run_point(name, extra, args.timeout, prog)
             results.append(row)
             if str(row.get("error", "")).startswith("timeout"):
                 # a timeout is ambiguous: slow point vs fabric death mid-point
@@ -210,7 +238,9 @@ def main() -> None:
         done = {r.get("point") for r in keep_new}
         merged = [r for r in prior if r.get("point") not in done] + keep_new
         serving = [r for r in merged
-                   if r.get("value") and not r["point"].startswith("longctx")
+                   if r.get("value")
+                   and not r["point"].startswith(("longctx", "mla-", "warm-"))
+                   and r.get("metric") == "output_tok_per_s_per_chip"
                    and r.get("workload", "uniform") == "uniform"]
         best = max(serving, key=lambda r: r["value"]) if serving else None
         with open(out_path, "w") as f:  # flush after EVERY point
