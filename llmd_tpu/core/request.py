@@ -28,6 +28,40 @@ HDR_PREFILLER_HOST_PORT = "x-prefiller-host-port"
 # forwards the REMAINDER under the same name, so the engine always sees how
 # much budget the client has left, not the original figure.
 HDR_REQUEST_TIMEOUT = "x-request-timeout"
+# Tenant identity for per-tenant accounting + SLO attainment
+# (observability/slo-attribution.md). Absent/invalid → "anon". The router
+# forwards the clamped value so engine-side timelines carry the same tenant.
+HDR_TENANT = "x-llm-d-tenant"
+
+# Identifier hygiene: both the tenant label and client-supplied request ids
+# become flight-recorder keys and metric/exemplar label values, so hostile
+# headers must not be able to bloat either.
+_IDENT_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-")
+MAX_TENANT_LEN = 64
+MAX_REQUEST_ID_LEN = 128
+
+
+def clamp_tenant(raw: Optional[str]) -> str:
+    """Validate a tenant header value: bounded length, [A-Za-z0-9._-] only.
+    Anything else collapses to "anon" — an invalid tenant must not mint a
+    fresh metric label set."""
+    if not raw:
+        return "anon"
+    v = raw.strip()
+    if not v or len(v) > MAX_TENANT_LEN or not set(v) <= _IDENT_CHARS:
+        return "anon"
+    return v
+
+
+def clamp_request_id(raw: Optional[str]) -> str:
+    """Validate a client x-request-id; invalid/oversized values fall back to
+    a generated id rather than keying recorder entries on hostile bytes."""
+    if raw:
+        v = raw.strip()
+        if v and len(v) <= MAX_REQUEST_ID_LEN and set(v) <= _IDENT_CHARS:
+            return v
+    return uuid.uuid4().hex
 
 
 def media_url_of_part(part: Any) -> "tuple[Optional[str], Optional[str]]":
@@ -216,6 +250,7 @@ class InferenceRequest:
     # Header-derived routing state.
     objective: Optional[str] = None  # InferenceObjective name → priority band
     fairness_id: str = ""  # FlowKey = (fairness_id, priority)
+    tenant: str = "anon"  # clamped x-llm-d-tenant (accounting + SLO gauges)
     priority: int = 0
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
@@ -262,6 +297,7 @@ class InferenceRequest:
         get = {k.lower(): v for k, v in headers.items()}.get
         req.objective = get(HDR_OBJECTIVE)
         req.fairness_id = get(HDR_FAIRNESS_ID, "") or ""
+        req.tenant = clamp_tenant(get(HDR_TENANT))
         # Malformed client-supplied SLO headers are ignored, not fatal.
         for hdr, attr in ((HDR_SLO_TTFT_MS, "slo_ttft_ms"), (HDR_SLO_TPOT_MS, "slo_tpot_ms")):
             raw = get(hdr)

@@ -209,6 +209,11 @@ class LLMEngine:
         # always-on per-request lifecycle timelines; EngineServer exposes
         # this recorder at /debug/requests (obs.events)
         self.flight = FlightRecorder.from_env(tracer=self.tracer)
+        # latency attribution: every retired timeline folds into the phase
+        # ledger and exports llmd_tpu:request_phase_seconds{phase,tenant,model}
+        from llmd_tpu.obs.attribution import attach_phase_exporter
+
+        attach_phase_exporter(self.flight, self.metrics.request_phase)
         # device-plane monitor (obs/device.py): attached by the owning
         # EngineServer at start(); the dispatch loop stamps its heartbeat
         self.monitor = None

@@ -363,11 +363,15 @@ class EngineServer:
         self.transfer_stats["prefix_pulls"] += 1
         self._pending_pulls[rid] = (ktp.remote_host, ktp.remote_port,
                                     ktp.remote_request_id)
+        t0 = time.monotonic()
         try:
             n, outcome, released = pull_prefix_into(self, ktp, token_ids,
                                                     lora_id, mm_hashes)
         except Exception:
             n, outcome, released = 0, "error", False
+        pull_s = time.monotonic() - t0
+        self.server_metrics.prefix_pull_seconds.labels(
+            outcome=outcome).observe(pull_s)
         if released:
             self._pending_pulls.pop(rid, None)
         if n:
@@ -378,6 +382,7 @@ class EngineServer:
         # idempotent, so open it here and let add_request backfill the model
         self.engine.flight.start(rid)
         self.engine.flight.record(rid, "kv_pull", outcome=outcome, blocks=n,
+                                  ms=round(pull_s * 1e3, 3),
                                   peer=f"{ktp.remote_host}:{ktp.remote_port}")
         return n
 
@@ -584,6 +589,14 @@ class EngineServer:
                 None, self._pull_prefix_kv, rid, ktp, token_ids, lora_id,
                 mm_hashes
             )
+
+        # the engine mints its own rid, so the router's tenant header is the
+        # only identity link: open (or backfill) the flight record with it
+        # before admission so the engine-side ledger carries the tenant too
+        from llmd_tpu.core.request import HDR_TENANT, clamp_tenant
+
+        self.engine.flight.start(
+            rid, tenant=clamp_tenant(request.headers.get(HDR_TENANT)))
 
         try:
             gen = self.async_engine.generate(rid, token_ids, sampling, lora_id,

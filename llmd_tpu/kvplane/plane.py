@@ -229,6 +229,19 @@ class KVPlane:
                 return False  # feed stale: no batch movement in stale_s
         return True
 
+    def feed_age_s(self) -> float:
+        """Seconds since the event feed last showed batch movement (0 while
+        batches keep arriving) — the index-staleness gauge/alert input."""
+        sub = self.subscriber
+        if sub is None:
+            return 0.0
+        now = time.monotonic()
+        if sub.batches_received != self._feed_batches:
+            # movement since last check: index_ready() will re-stamp; report
+            # fresh without mutating its bookkeeping here
+            return 0.0
+        return max(0.0, now - self._feed_seen_t)
+
     # ------------------------------------------------------------- pulls
     def plan_pull(self, req: InferenceRequest, target_address: str) -> Optional[dict]:
         """KV-transfer params to stamp on ``req`` bound for ``target_address``,

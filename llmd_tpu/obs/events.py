@@ -59,6 +59,7 @@ EVENT_CATALOG = (
     "hedge",
     "breaker_open",
     "breaker_close",
+    "slo_breach",
     # engine plane
     "admitted",
     "prefill_start",
@@ -99,14 +100,16 @@ _TERMINAL_STATUS = {"finished", "aborted", "rejected", "error"}
 class RequestRecord:
     """One request's timeline. Mutated only under the recorder lock."""
 
-    __slots__ = ("request_id", "model", "trace_id", "status", "t0_mono",
-                 "t0_wall", "events", "events_dropped", "finish_reason",
-                 "e2e_s", "retained")
+    __slots__ = ("request_id", "model", "trace_id", "tenant", "status",
+                 "t0_mono", "t0_wall", "events", "events_dropped",
+                 "finish_reason", "e2e_s", "retained")
 
-    def __init__(self, request_id: str, model: str, trace_id: str) -> None:
+    def __init__(self, request_id: str, model: str, trace_id: str,
+                 tenant: str = "") -> None:
         self.request_id = request_id
         self.model = model
         self.trace_id = trace_id
+        self.tenant = tenant
         self.status = "active"
         self.t0_mono = time.monotonic()
         self.t0_wall = time.time()
@@ -127,6 +130,7 @@ class RequestRecord:
             "request_id": self.request_id,
             "model": self.model,
             "trace_id": self.trace_id,
+            "tenant": self.tenant,
             "status": self.status,
             "start_unix": round(self.t0_wall, 3),
             "latency_ms": round(self.latency_s() * 1e3, 3),
@@ -153,6 +157,9 @@ class FlightRecorder:
         self.slo_ms = float(slo_ms)
         self.tail_keep = max(0, int(tail_keep))
         self.tracer = tracer
+        # Owner-set retire hook: called with the finished record's to_dict()
+        # AFTER the lock is released (the attribution exporter hangs here).
+        self.on_finish = None
         self._lock = threading.Lock()
         self._records: "OrderedDict[str, RequestRecord]" = OrderedDict()
         # non-request-scoped events (offload-tier demotions etc.)
@@ -170,16 +177,19 @@ class FlightRecorder:
 
     # ------------------------------------------------------------- recording
     def start(self, request_id: str, model: str = "",
-              trace_id: str = "") -> None:
+              trace_id: str = "", tenant: str = "") -> None:
         """Open a record (idempotent: a re-start keeps the existing timeline
-        but backfills model/trace if the first opener didn't know them)."""
+        but backfills model/trace/tenant if the first opener didn't know
+        them)."""
         with self._lock:
             rec = self._records.get(request_id)
             if rec is not None:
                 rec.model = rec.model or model
                 rec.trace_id = rec.trace_id or trace_id
+                rec.tenant = rec.tenant or tenant
                 return
-            self._records[request_id] = RequestRecord(request_id, model, trace_id)
+            self._records[request_id] = RequestRecord(request_id, model,
+                                                      trace_id, tenant)
             self._evict_locked()
 
     def record(self, request_id: str, event: str, **attrs: Any) -> None:
@@ -203,6 +213,7 @@ class FlightRecorder:
         """Terminal transition: records ``event`` (bypassing the per-request
         cap), stamps e2e latency, and applies SLO tail capture."""
         breach: Optional[RequestRecord] = None
+        finished: Optional[dict] = None
         with self._lock:
             rec = self._records.get(request_id)
             if rec is None or rec.status in _TERMINAL_STATUS:
@@ -215,8 +226,15 @@ class FlightRecorder:
                 rec.retained = True
                 self._trim_tail_locked()
                 breach = rec
+            if self.on_finish is not None:
+                finished = rec.to_dict()
         if breach is not None:
             self._force_trace(breach)
+        if finished is not None:
+            try:
+                self.on_finish(finished)
+            except Exception:
+                pass  # exporters must never take down retirement
 
     # --------------------------------------------------------------- queries
     def get(self, request_id: str) -> Optional[dict]:
@@ -357,8 +375,16 @@ def debug_list_response(flight: FlightRecorder, query) -> tuple:
 
 
 def debug_detail_response(flight: FlightRecorder, request_id: str) -> tuple:
-    """``GET /debug/requests/<id>`` body: (http_status, payload)."""
+    """``GET /debug/requests/<id>`` body: (http_status, payload). The detail
+    view embeds the phase-attribution ledger so "where did the time go" is
+    answerable from the same fetch as "what happened"."""
     rec = flight.get(request_id)
     if rec is None:
         return 404, {"error": f"unknown request id {request_id!r}"}
+    try:
+        from llmd_tpu.obs.attribution import build_ledger
+
+        rec["phase_ledger"] = build_ledger(rec)
+    except Exception:
+        pass
     return 200, rec

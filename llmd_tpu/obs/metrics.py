@@ -586,6 +586,19 @@ class EngineMetrics:
             "llmd_tpu:structured_violations_total",
             "Tokens observed outside the active grammar (incl. truncated "
             "constrained generations counted at retirement)")
+        # Latency attribution (obs/attribution.py): each retired request's
+        # flight timeline folds into a phase ledger; phases + the
+        # unattributed residual sum to wall clock by construction. The same
+        # family name is declared by RouterMetrics — registration is
+        # idempotent, each plane feeds its own registry.
+        self.request_phase = reg.histogram(
+            "llmd_tpu:request_phase_seconds",
+            "Per-request wall time attributed to a lifecycle phase at "
+            "retirement (phase=unattributed is the ledger residual — the "
+            "unknown-unknown detector)",
+            labelnames=("phase", "tenant", "model"),
+            buckets=(0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0))
 
 
 class EngineServerMetrics:
@@ -610,6 +623,13 @@ class EngineServerMetrics:
         self.transfer_registrations = reg.gauge(
             "llmd_tpu:kv_transfer_registrations",
             "Live KV export registrations held by the transfer source")
+        self.prefix_pull_seconds = reg.histogram(
+            "llmd_tpu:kv_transfer_prefix_pull_seconds",
+            "Wall time of router-stamped cross-engine prefix pulls, by "
+            "outcome (hit|empty|miss|peer_dead|error)",
+            labelnames=("outcome",),
+            buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5))
 
 
 class RouterMetrics:
@@ -714,6 +734,84 @@ class RouterMetrics:
         self.kvplane_index_blocks = reg.gauge(
             "llm_d_epp_kv_plane_index_blocks",
             "Block-hash keys resident in the router's KV index")
+        self.kvplane_feed_age = reg.gauge(
+            "llm_d_epp_kv_plane_feed_age_seconds",
+            "Seconds since the KV plane last applied an event batch "
+            "(scrape-time; index-staleness alert input)")
+        # Latency attribution: router-plane ledger for the same family the
+        # engine declares (registration is idempotent; separate registries).
+        self.request_phase = reg.histogram(
+            "llmd_tpu:request_phase_seconds",
+            "Per-request wall time attributed to a lifecycle phase at "
+            "retirement (phase=unattributed is the ledger residual — the "
+            "unknown-unknown detector)",
+            labelnames=("phase", "tenant", "model"),
+            buckets=(0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                     10.0, 30.0))
+        # Per-tenant accounting (x-llm-d-tenant, default "anon"): the
+        # fairness foundation — token spend and request volume by tenant.
+        self.tenant_requests = reg.counter(
+            "llm_d_epp_tenant_requests_total",
+            "Requests received, by tenant and model",
+            labelnames=("tenant", "model"))
+        self.tenant_prompt_tokens = reg.counter(
+            "llm_d_epp_tenant_prompt_tokens_total",
+            "Prompt tokens consumed, by tenant and model (from upstream "
+            "usage accounting)",
+            labelnames=("tenant", "model"))
+        self.tenant_completion_tokens = reg.counter(
+            "llm_d_epp_tenant_completion_tokens_total",
+            "Completion tokens generated, by tenant and model",
+            labelnames=("tenant", "model"))
+        # SLO objectives + burn rate (obs/slo.py, LLMD_SLO_*): attainment and
+        # burn gauges are scrape-time callbacks over the rolling windows.
+        self.slo_attainment = reg.gauge(
+            "llm_d_epp_slo_attainment",
+            "Rolling fraction of requests meeting the objective, per tenant "
+            "x objective (ttft|e2e) x window (5m|1h)",
+            labelnames=("tenant", "objective", "window"))
+        self.slo_burn_rate = reg.gauge(
+            "llm_d_epp_slo_burn_rate",
+            "Error-budget burn rate: (1 - attainment) / (1 - target); 1.0 "
+            "burns the budget exactly at the objective rate",
+            labelnames=("tenant", "objective", "window"))
+        self.slo_breaches = reg.counter(
+            "llm_d_epp_slo_breaches_total",
+            "Individual requests that missed their objective",
+            labelnames=("tenant", "objective"))
+        # Fleet rollup plane (obs/fleet.py): aggregated over MetricsPoller
+        # scrapes so ONE router scrape answers fleet health — the pool
+        # controller and dashboards consume these instead of re-deriving
+        # fleet state from per-replica series.
+        self.fleet_replicas = reg.gauge(
+            "llmd_tpu:fleet_replicas",
+            "Replicas currently contributing to the fleet rollup")
+        self.fleet_tokens_per_second = reg.gauge(
+            "llmd_tpu:fleet_tokens_per_second",
+            "Fleet-wide generation throughput from scrape-to-scrape decode "
+            "token-counter deltas")
+        self.fleet_running = reg.gauge(
+            "llmd_tpu:fleet_running_requests",
+            "Sum of running sequences across the fleet")
+        self.fleet_waiting = reg.gauge(
+            "llmd_tpu:fleet_waiting_requests",
+            "Sum of queued sequences across the fleet")
+        self.fleet_hbm_headroom_min = reg.gauge(
+            "llmd_tpu:fleet_hbm_headroom_bytes_min",
+            "Smallest per-replica HBM headroom (limit - in-use, summed over "
+            "the replica's devices) — the next-OOM candidate")
+        self.fleet_hbm_headroom_total = reg.gauge(
+            "llmd_tpu:fleet_hbm_headroom_bytes_total",
+            "Total HBM headroom across the fleet")
+        self.fleet_kv_utilization = reg.gauge(
+            "llmd_tpu:fleet_kv_utilization_mean",
+            "Mean KV cache utilization across replicas (0..1)")
+        self.fleet_fabric_alive = reg.gauge(
+            "llmd_tpu:fleet_fabric_alive_replicas",
+            "Replicas whose device fabric liveness probe is passing")
+        self.fleet_stalled = reg.gauge(
+            "llmd_tpu:fleet_stalled_replicas",
+            "Replicas whose step watchdog currently reports a stall")
 
 
 class PoolMetricsFamilies:

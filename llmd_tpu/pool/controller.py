@@ -138,6 +138,10 @@ class PoolController:
         self.pool = pool if pool is not None else (
             router.pool if router is not None else EndpointPool())
         self.resilience = getattr(router, "resilience", None)
+        # fleet rollup (obs/fleet.py): when the router aggregates replica
+        # scrapes, the controller consumes the rollup instead of re-summing
+        # per-replica attributes on every reconcile tick
+        self.fleet = getattr(router, "fleet", None)
         self.flight = flight if flight is not None else getattr(
             router, "flight", None)
         if flow_depth_fn is not None:
@@ -265,6 +269,8 @@ class PoolController:
         return out
 
     def _running_total(self) -> float:
+        if self.fleet is not None and len(self.fleet) > 0:
+            return self.fleet.running_total()
         return sum(
             self.pool.get(a).metric(StdMetric.RUNNING_REQUESTS)
             for a in self.replicas if self.pool.get(a) is not None)

@@ -116,11 +116,21 @@ class MetricsPoller:
 
     def forget(self, address: str) -> None:
         """Drop an endpoint's error-count keys when it leaves discovery —
-        scale-cycle churn must not grow the map without bound."""
+        scale-cycle churn must not grow the map without bound. Cascades to
+        extractors holding per-endpoint state (fleet rollup) for the same
+        reason."""
         self.error_counts.pop(address, None)
         for key in [k for k in self.error_counts
                     if k.startswith(address + ":")]:
             del self.error_counts[key]
+        for ext in self.extractors:
+            fn = getattr(ext, "forget", None)
+            if fn is not None:
+                try:
+                    fn(address)
+                except Exception:
+                    key = f"{address}:{ext.name}"
+                    self.error_counts[key] = self.error_counts.get(key, 0) + 1
 
     async def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._loop())

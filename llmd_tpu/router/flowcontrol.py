@@ -231,7 +231,8 @@ class FlowController:
         if self.flight is not None:
             self.flight.record(req.request_id, "flow_enqueue",
                                priority=req.priority, band=band.spec.name,
-                               queue_depth=self._total_queued())
+                               queue_depth=self._total_queued(),
+                               tenant=req.tenant or None)
         self._wake.set()
         return await fut
 

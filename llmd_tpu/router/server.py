@@ -28,9 +28,11 @@ from llmd_tpu.core.endpoint import EndpointPool
 from llmd_tpu.core.request import (
     HDR_PREFILLER_HOST_PORT,
     HDR_REQUEST_TIMEOUT,
+    HDR_TENANT,
     InferenceRequest,
     RequestOutcome,
     SamplingParams,
+    clamp_request_id,
 )
 from llmd_tpu.router.datalayer import MetricsPoller
 from llmd_tpu.router.flowcontrol import FlowController
@@ -277,6 +279,31 @@ class RouterServer:
             lambda: plane.stats["pulls_planned"])
         self.metrics.kvplane_index_blocks.set_function(
             lambda: len(plane.index) if plane.index is not None else 0)
+        self.metrics.kvplane_feed_age.set_function(plane.feed_age_s)
+        # SLO objectives + burn rate (obs/slo.py, LLMD_SLO_*): per-tenant
+        # attainment/burn gauges are scrape-time callbacks over the rolling
+        # windows; individual breaches land on the flight timeline.
+        from llmd_tpu.obs.slo import SLOEngine
+
+        self.slo = SLOEngine.from_env()
+        self.slo.breach_counter = self.metrics.slo_breaches
+        self.metrics.slo_attainment.set_labels_function(
+            lambda: self.slo.gauge_samples("attainment"))
+        self.metrics.slo_burn_rate.set_labels_function(
+            lambda: self.slo.gauge_samples("burn"))
+        # Latency attribution: fold each retired router timeline into the
+        # phase ledger and export llmd_tpu:request_phase_seconds.
+        from llmd_tpu.obs.attribution import attach_phase_exporter
+
+        attach_phase_exporter(self.flight, self.metrics.request_phase)
+        # Fleet rollup plane (obs/fleet.py): rides the poller's extractor
+        # chain; one router scrape then answers fleet tok/s, HBM headroom,
+        # KV residency, fabric/stall counts without touching any replica.
+        from llmd_tpu.obs.fleet import FleetRollup
+
+        self.fleet = FleetRollup()
+        self.poller.extractors.append(self.fleet)
+        self.fleet.bind_gauges(self.metrics)
         # Discovery eviction: an endpoint leaving the pool (scale-down,
         # replica death) takes its breaker/draining/error-count state with
         # it — churned replicas must not leak state across scale cycles.
@@ -418,6 +445,30 @@ class RouterServer:
         # (trace_id of the active span) lets Grafana jump bucket → trace
         self.metrics.e2e.observe(seconds, exemplar=exemplar)
 
+    def _observe_slo(self, req: InferenceRequest, objective: str,
+                     seconds: float) -> None:
+        """Feed one latency sample into the SLO engine; a breach lands on
+        the request's flight timeline (and the breach counter via the
+        engine's hook) so slow-tail triage starts from the ledger."""
+        if not self.slo.enabled:
+            return
+        if self.slo.observe(req.tenant, objective, seconds):
+            self.flight.record(req.request_id, "slo_breach",
+                               objective=objective, tenant=req.tenant,
+                               latency_ms=round(seconds * 1e3, 3))
+
+    def _account_usage(self, req: InferenceRequest, usage: dict) -> None:
+        """Per-tenant token accounting from upstream usage payloads."""
+        for key, fam in (("prompt_tokens", self.metrics.tenant_prompt_tokens),
+                         ("completion_tokens",
+                          self.metrics.tenant_completion_tokens)):
+            try:
+                n = float(usage.get(key) or 0)
+            except (TypeError, ValueError):
+                continue
+            if n > 0:
+                fam.labels(tenant=req.tenant, model=req.model).inc(n)
+
     def prepare_request(self, path: str, body: dict,
                         headers: dict[str, str]) -> InferenceRequest:
         """Parse + apply objectives and model rewrite (mutates ``body`` on
@@ -425,7 +476,11 @@ class RouterServer:
         gateway-mode ext-proc path."""
         req = self._parser(path, body, headers)
         lower = {k.lower(): v for k, v in headers.items()}
-        req.request_id = lower.get("x-request-id", uuid.uuid4().hex)
+        # clamped, not trusted: client ids become flight-recorder keys and
+        # exemplar labels, so hostile bytes fall back to a generated id
+        req.request_id = clamp_request_id(lower.get("x-request-id"))
+        self.metrics.tenant_requests.labels(tenant=req.tenant,
+                                            model=req.model).inc()
         if req.objective and req.objective in self.objectives:
             req.priority = self.objectives[req.objective]
         if req.timeout_s is None:
@@ -682,7 +737,8 @@ class RouterServer:
                 **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
                    "http.route": request.path, "llm_d.sticky": True})
             self.flight.start(req.request_id, model=req.model,
-                              trace_id=span.context.trace_id)
+                              trace_id=span.context.trace_id,
+                              tenant=req.tenant)
             self.flight.record(req.request_id, "arrival", path=request.path,
                                sticky=True)
             rej = await self._flow_gate(req, span)
@@ -728,6 +784,7 @@ class RouterServer:
                 fwd_headers={"content-type": "application/json",
                              "traceparent": span.traceparent(),
                              "x-request-id": req.request_id,
+                             HDR_TENANT: req.tenant,
                              HDR_REQUEST_TIMEOUT: f"{budget:.3f}"})
             # sticky traffic can't route around its pod, but its outcomes
             # still teach the breaker (protects the scheduled path)
@@ -750,7 +807,7 @@ class RouterServer:
             **{"llm_d.request_id": req.request_id, "llm_d.model": req.model,
                "http.route": request.path})
         self.flight.start(req.request_id, model=req.model,
-                          trace_id=span.context.trace_id)
+                          trace_id=span.context.trace_id, tenant=req.tenant)
         self.flight.record(req.request_id, "arrival", path=request.path)
 
         result, err = await self.admit_and_schedule(req, span=span)
@@ -804,6 +861,7 @@ class RouterServer:
             fwd_headers = {"content-type": "application/json",
                            "traceparent": span.traceparent(),
                            "x-request-id": req.request_id,
+                           HDR_TENANT: req.tenant,
                            # the engine sees the REMAINING budget, not the
                            # client's original: queue wait already spent it
                            HDR_REQUEST_TIMEOUT: f"{budget:.3f}"}
@@ -900,6 +958,7 @@ class RouterServer:
                             t_first = t_last
                             self.metrics.ttft.observe(t_first - t_start,
                                                       exemplar=exemplar)
+                            self._observe_slo(req, "ttft", t_first - t_start)
                         n_chunks += 1
                         await out.write(chunk)
                     await out.write_eof()
@@ -930,6 +989,7 @@ class RouterServer:
                 self.metrics.responses.inc()
                 if "e2e_ms" in info:
                     self._observe_e2e(info["e2e_ms"] / 1e3, exemplar=exemplar)
+                    self._observe_slo(req, "e2e", info["e2e_ms"] / 1e3)
                 self.flight.finish(
                     req.request_id, event="response", status="finished",
                     http_status=resp.status,
@@ -962,10 +1022,12 @@ class RouterServer:
             self.resilience.note_latency(e2e_s)
             exemplar = {"trace_id": span.context.trace_id}
             self.metrics.ttft.observe(e2e_s, exemplar=exemplar)
+            self._observe_slo(req, "ttft", e2e_s)
             info = {"status": resp.status, "e2e_ms": e2e_s * 1e3}
             try:
                 usage = json.loads(payload).get("usage", {})
                 info["usage"] = usage
+                self._account_usage(req, usage)
                 if usage.get("completion_tokens"):
                     info["itl_ms"] = e2e_s * 1e3 / usage["completion_tokens"]
             except Exception:
@@ -973,6 +1035,7 @@ class RouterServer:
             self.scheduler.post_response(req, target, info)
             self.metrics.responses.inc()
             self._observe_e2e(e2e_s, exemplar=exemplar)
+            self._observe_slo(req, "e2e", e2e_s)
             self.flight.finish(req.request_id, event="response",
                                status="finished", http_status=resp.status)
             span.set_attribute("llm_d.e2e_ms", round(info["e2e_ms"], 3))

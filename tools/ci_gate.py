@@ -72,6 +72,12 @@ def main() -> int:
         # global KV plane: precise routing >= 90% prefix-served, cross-engine
         # pull exercised, engine killed mid-run with zero 5xx, index bounded
         ("kv-plane-check", [py, "tools/kv_plane_check.py"], CPU_ENV),
+        # perf contract: the pinned campaign point must agree with the pinned
+        # BENCH baseline under per-metric tolerances — catches accidental edits
+        # to either artifact and keeps the comparator itself exercised
+        ("perf-regress", [py, "tools/perf_regress.py",
+                          "--candidate", "BENCH_CAMPAIGN_r05.json",
+                          "--baseline", "BENCH_r05.json"], None),
     ]
     if not args.skip_tests:
         pytest_cmd = [py, "-m", "pytest", "tests/", "-q"]

@@ -17,6 +17,9 @@ Usage:
   # one request's full timeline
   python tools/dump_flight.py http://localhost:8000 --id 1a2b3c...
 
+  # where did the time go: phase-attribution ledger per request
+  python tools/dump_flight.py http://localhost:8000 --id 1a2b3c... --phases
+
   # correlate a trace with its flight timeline(s): every request that
   # carried this W3C trace id, rendered as full timelines
   python tools/dump_flight.py http://localhost:8000 --trace 4bf92f35...
@@ -31,9 +34,16 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import urllib.parse
 import urllib.request
+
+# repo root on sys.path so the lazy llmd_tpu import in render_phases works
+# when invoked as `python tools/dump_flight.py` (script dir != repo root)
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 
 def _fetch(url: str, timeout: float) -> dict:
@@ -91,8 +101,9 @@ def _fmt_attrs(ev: dict) -> str:
                     if k not in ("event", "t_ms", "t_unix"))
 
 
-def render_timeline(rec: dict, out=sys.stdout) -> None:
+def render_timeline(rec: dict, out=sys.stdout, phases: bool = False) -> None:
     print(f"request {rec.get('request_id')}  model={rec.get('model') or '-'}  "
+          f"tenant={rec.get('tenant') or '-'}  "
           f"status={rec.get('status')}  latency={rec.get('latency_ms')}ms  "
           f"trace={rec.get('trace_id') or '-'}", file=out)
     if rec.get("finish_reason"):
@@ -103,6 +114,30 @@ def render_timeline(rec: dict, out=sys.stdout) -> None:
     for ev in rec.get("events", []):
         print(f"  {ev['t_ms']:>10.3f}ms  {ev['event']:<18} {_fmt_attrs(ev)}",
               file=out)
+    if phases:
+        render_phases(rec, out=out)
+
+
+def render_phases(rec: dict, out=sys.stdout) -> None:
+    """Phase-attribution ledger table (obs/attribution.py): which lifecycle
+    phases the request's wall clock went to, residual included. Works on
+    detail payloads (events present) computed locally, so offline dumps and
+    older servers without the embedded ledger both render."""
+    from llmd_tpu.obs.attribution import build_ledger
+
+    if not rec.get("events"):
+        print("  (no events: phase ledger unavailable — summaries carry no "
+              "timeline; use --id or --trace for detail records)", file=out)
+        return
+    ledger = rec.get("phase_ledger") or build_ledger(rec)
+    total = sum(ledger["phases"].values()) + ledger["residual_ms"]
+    print(f"  phase ledger ({ledger['plane']} plane, "
+          f"wall {ledger['wall_ms']}ms):", file=out)
+    rows = sorted(ledger["phases"].items(), key=lambda kv: -kv[1])
+    rows.append(("unattributed (residual)", ledger["residual_ms"]))
+    for phase, ms in rows:
+        pct = 100.0 * ms / total if total > 0 else 0.0
+        print(f"    {phase:<26} {ms:>12.3f}ms  {pct:>5.1f}%", file=out)
 
 
 def render_list(payload: dict, out=sys.stdout) -> None:
@@ -139,6 +174,10 @@ def main(argv=None) -> int:
     ap.add_argument("--model", help="filter by model name")
     ap.add_argument("--min-latency-ms", type=float, default=None,
                     help="filter: e2e (or age-so-far) at least this")
+    ap.add_argument("--phases", action="store_true",
+                    help="append the phase-attribution ledger (where the "
+                         "wall clock went, residual included) to each "
+                         "rendered timeline")
     ap.add_argument("--limit", type=int, default=100)
     ap.add_argument("--timeout", type=float, default=10.0)
     ap.add_argument("--save", metavar="PATH",
@@ -162,7 +201,7 @@ def main(argv=None) -> int:
         if not recs:
             print(f"error: request {args.id!r} not found", file=sys.stderr)
             return 1
-        render_timeline(recs[0])
+        render_timeline(recs[0], phases=args.phases)
     elif args.trace:
         # offline dumps filter here; live payloads arrive pre-filtered (and
         # already carry full timelines) — the filter is then a no-op
@@ -174,7 +213,12 @@ def main(argv=None) -> int:
             return 1
         print(f"trace {args.trace}: {len(recs)} request(s)")
         for rec in recs:
-            render_timeline(rec)
+            render_timeline(rec, phases=args.phases)
+    elif args.phases:
+        # list mode with --phases: render every record that carries events
+        # (offline full dumps do; live summaries print the hint instead)
+        for rec in payload["requests"]:
+            render_timeline(rec, phases=True)
     else:
         render_list(payload)
     return 0
