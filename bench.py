@@ -184,14 +184,23 @@ def main() -> None:
                          "(EngineConfig.pipeline_depth; default: config "
                          "default)")
     ap.add_argument("--workload", default="uniform",
-                    choices=["uniform", "echo", "json"],
+                    choices=["uniform", "echo", "json", "json-echo"],
                     help="prompt distribution: uniform = distinct pseudo-random "
                          "streams (no lookup structure); echo = periodic "
                          "prompts whose continuations repeat — the shared-"
                          "prefix/agentic/summarization regime where prompt-"
                          "lookup acceptance is high; json = every request is "
                          "schema-constrained (response_format json_schema) — "
-                         "prices the structured-outputs mask path end to end")
+                         "prices the structured-outputs mask path end to end; "
+                         "json-echo = echo prompts AND schema constraint — the "
+                         "structured x speculative compose (Lever 13): "
+                         "grammar-masked verify accepts drafts on constrained "
+                         "rows (pair with --spec-mode ngram)")
+    ap.add_argument("--assert-spec-structured", action="store_true",
+                    help="fail unless constrained rows accepted >0 draft "
+                         "tokens AND the run had 0 structured violations — "
+                         "ci_gate's bench-tiny-spec-structured stage pins the "
+                         "grammar-masked verify path end to end")
     args = ap.parse_args()
     tiny = args.tiny
     if args.cpu:
@@ -365,18 +374,50 @@ def main() -> None:
                        "ok": {"type": "boolean"}},
         "required": ["n", "c", "ok"],
     }
+    if args.workload == "json-echo":
+        # constrained-echo: a fixed-count array of identical single-enum
+        # objects serializes to a fully-forced PERIODIC string
+        # ('[{"s":"on"},{"s":"on"},...]', period 11 chars) — after the first
+        # element the prompt-lookup drafter reads every next element from the
+        # sequence's own output, and the grammar-masked verify program
+        # accepts whole drafts (the structured analogue of the echo
+        # workload's repeated spans; the reference regime is agentic tool
+        # loops re-emitting near-identical JSON). Element count scales with
+        # osl so the echo body, not the EOS tail, dominates the measurement.
+        n_items = max(1, (osl - 10) // 11)
+        bench_schema = {
+            "type": "array",
+            "items": {"type": "object", "properties": {"s": {"enum": ["on"]}},
+                      "required": ["s"]},
+            "minItems": n_items, "maxItems": n_items,
+        }
 
     def _sampling() -> SamplingParams:
         kw = dict(max_tokens=osl, temperature=0.0, ignore_eos=True)
-        if args.workload == "json":
+        if args.workload.startswith("json"):
             kw["response_format"] = {"type": "json_schema",
                                      "json_schema": {"schema": bench_schema}}
         return SamplingParams(**kw)
 
     sp = _sampling()
 
-    def prompts(n: int, salt: int):
-        if args.workload == "echo":
+    def prompts(n: int, salt: int, tok=None):
+        if args.workload == "json-echo" and tok is not None:
+            # the constrained-echo regime proper: the prompt carries the
+            # forced serialization pattern the output will repeat (an
+            # agentic tool loop re-emitting JSON it saw in context), so
+            # prompt-lookup drafts fire from the first generated token
+            # instead of waiting for the output's own first element. A
+            # salted head keeps prompts distinct (no prefix-cache shortcut).
+            pat = tok.encode('[{"s":"on"},' + '{"s":"on"},' * 3)
+            out = []
+            for i in range(n):
+                head = [(salt * 7919 + i * 131 + j) % (cfg.vocab_size - 2) + 1
+                        for j in range(4)]
+                body = (pat * (isl // max(1, len(pat)) + 1))[: isl - len(head)]
+                out.append(head + body)
+            return out
+        if args.workload in ("echo", "json-echo"):
             # echo-heavy: each prompt is a short per-request pattern repeated
             # to ISL (still distinct across requests — no prefix-cache
             # shortcut), so the continuation repeats spans of the context —
@@ -399,7 +440,7 @@ def main() -> None:
         run_cfg.max_model_len = max(run_cfg.max_model_len, isl + osl + lookahead + 1)
         t0 = time.monotonic()
         tok = None
-        if args.workload == "json":
+        if args.workload.startswith("json"):
             from llmd_tpu.engine.tokenizer import load_tokenizer
 
             # HF checkpoints carry their tokenizer; random weights mask over
@@ -416,7 +457,7 @@ def main() -> None:
               file=sys.stderr)
         print(f"# moe_backend={eng.moe_backend}", file=sys.stderr)
         t0 = time.monotonic()
-        eng.generate(prompts(2, salt=1), _sampling())
+        eng.generate(prompts(2, salt=1, tok=tok), _sampling())
         print(f"# warmup/compile {time.monotonic() - t0:.1f}s", file=sys.stderr)
         # fresh stats for the measured window (every counter zeroed by construction)
         from llmd_tpu.engine.engine import EngineStats
@@ -427,7 +468,7 @@ def main() -> None:
                                 kv_cache_dtype=eng.stats.kv_cache_dtype,
                                 kv_layout=eng.stats.kv_layout)
         t0 = time.monotonic()
-        out = eng.generate(prompts(n_req, salt=2), sp)
+        out = eng.generate(prompts(n_req, salt=2, tok=tok), sp)
         return eng, out, time.monotonic() - t0
 
     def tune_attention() -> "str | None":
@@ -639,6 +680,20 @@ def main() -> None:
     out_tokens = sum(len(v) for v in out.values())
     assert out_tokens == n_req * osl, (out_tokens, n_req * osl)
     tput = out_tokens / wall
+    if args.assert_spec_structured:
+        # Lever 13 gate: the grammar-masked verify program must have landed
+        # real draft acceptances on constrained rows without a single
+        # conformance violation — a silent fallback to per-step decode would
+        # pass a plain throughput check while the lever is dead
+        st_ = eng.stats
+        assert st_.spec_accepted_constrained > 0, (
+            "no accepted drafts on constrained rows",
+            st_.spec_drafted_constrained, st_.spec_accepted_constrained)
+        assert st_.structured_violations == 0, (
+            "constrained-spec run produced violations",
+            st_.structured_violations)
+        assert st_.spec_fsm_crosscheck_mismatches == 0, (
+            st_.spec_fsm_crosscheck_mismatches)
 
     # --- provenance / roofline context -------------------------------------
     st = eng.stats
@@ -684,7 +739,9 @@ def main() -> None:
         print(f"# spec: drafted {st.spec_drafted}, accepted {st.spec_accepted}, "
               f"rejected {st.spec_rejected} over {st.n_spec_verify_steps} verify "
               f"steps ({st.spec_accepted / st.n_spec_verify_steps:.2f} "
-              f"accepted/verify-step)", file=sys.stderr)
+              f"accepted/verify-step; constrained "
+              f"{st.spec_accepted_constrained}/{st.spec_drafted_constrained} "
+              "accepted/drafted)", file=sys.stderr)
     print(f"# phase split: prefill-steps {st.time_prefill_steps:.2f}s, "
           f"decode-steps {st.time_decode_steps:.2f}s, "
           f"spec-steps {st.time_spec_steps:.2f}s, launch-gap {launch_gap:.2f}s | "
@@ -762,6 +819,12 @@ def main() -> None:
         "spec_drafted": st.spec_drafted,
         "spec_accepted": st.spec_accepted,
         "spec_rejected": st.spec_rejected,
+        # Lever 13 (structured x speculative): drafted/accepted on grammar- or
+        # logit_bias-constrained rows — the grammar-masked verify program's
+        # contribution, zero before this lever existed
+        "spec_drafted_constrained": st.spec_drafted_constrained,
+        "spec_accepted_constrained": st.spec_accepted_constrained,
+        "spec_fsm_crosscheck_mismatches": st.spec_fsm_crosscheck_mismatches,
         "spec_verify_steps": st.n_spec_verify_steps,
         "spec_accepted_per_verify_step": round(
             st.spec_accepted / st.n_spec_verify_steps, 3)

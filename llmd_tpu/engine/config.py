@@ -155,6 +155,19 @@ class EngineConfig:
     # f32 bias + i32 next ~= 8 bytes/element). Past this, constrained rows
     # fall back to the unified path rather than staging a huge table.
     structured_table_max_elems: int = 1 << 23
+    # Speculation × structured compose (PERF.md Lever 13): constrained rows
+    # draft through the host automaton (longest grammar-legal prefix of the
+    # n-gram continuation) and verify through the grammar-masked verify
+    # program, which returns each row's post-acceptance FSM state so the host
+    # resync becomes a recovery path. False restores the legacy behavior:
+    # constrained rows never draft and their presence disables verify steps.
+    spec_structured: bool = True
+    # Debug cross-check: after every masked verify step, re-derive each
+    # constrained row's FSM state on host (StructuredState.sync over the
+    # accepted tokens) and compare against the device-returned state; a
+    # mismatch adopts the host value and bumps
+    # stats.spec_fsm_crosscheck_mismatches (should stay 0).
+    spec_structured_crosscheck: bool = False
 
     @property
     def max_pages_per_seq(self) -> int:

@@ -42,6 +42,7 @@ from llmd_tpu.engine.sampling import (
     sample_tokens,
     sample_tokens_biased,
 )
+from llmd_tpu.engine.programs import ProgramRegistry, select_decode_attn_impl
 from llmd_tpu.engine.spec import propose_ngram_draft
 from llmd_tpu.structured import (
     NEG_BIAS,
@@ -130,6 +131,13 @@ class EngineStats:
     spec_accepted: int = 0
     spec_rejected: int = 0
     n_spec_verify_steps: int = 0
+    # Speculation × structured compose (PERF.md Lever 13): the constrained
+    # share of drafted/accepted (rows carrying a grammar or logit_bias),
+    # plus the crosscheck mismatch count when spec_structured_crosscheck is
+    # on (device-returned FSM state vs host StructuredState.sync; must be 0).
+    spec_drafted_constrained: int = 0
+    spec_accepted_constrained: int = 0
+    spec_fsm_crosscheck_mismatches: int = 0
     # Structured outputs (llmd_tpu/structured): grammar-constrained requests
     # admitted, host-side mask builds (time_mask_build is the feature's only
     # per-step host cost — PERF.md compares it against step wall time), and
@@ -269,11 +277,10 @@ class LLMEngine:
         # staged dense mask tables, LRU-keyed by the participating grammars'
         # identities + pad shape; entries pin (bias_tab, next_tab) on device
         self._mask_tab_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
-        # spec probe arming: prompt-lookup drafting only re-probes after fresh
-        # tokens actually landed — a negative probe disarms until the next
-        # _decode_process/_sample_apply/verify landing, removing the redundant
-        # O(context) numpy scans the per-step probe used to pay mid-chain
-        self._spec_armed = True
+        # (spec probe arming is per-sequence — Sequence.spec_armed: a negative
+        # prompt-lookup probe disarms that row until fresh tokens land for it,
+        # removing redundant O(context) numpy scans without letting one
+        # non-repetitive stream disarm drafting for the whole batch)
         # one in-flight prefill-step sample read (pipelined like decode: the
         # ~RTT-priced np.asarray of the sampled tokens defers until the NEXT
         # unified step is on the device, hiding the read behind its compute)
@@ -396,7 +403,7 @@ class LLMEngine:
             # attends over chunk activations, not the pool)
             attn = make_packed_attn(attn, model_cfg, self.kv_pack)
             self.attn_backend += f"+packed{self.kv_pack}"
-        attn_decode = self._select_decode_attn_impl(attn)
+        attn_decode = select_decode_attn_impl(self, attn)
         moe_impl = self._select_moe_impl()
         self.stats.attn_backend = self.attn_backend
         self.stats.attn_tune_hash = self.attn_tune_hash
@@ -472,6 +479,67 @@ class LLMEngine:
                 return greedy, cache, cnt
 
             return _verify
+
+        def _make_verify_masked(attn_fn):
+            def _verify_masked(params, cache, tokens, positions, seq_slots,
+                               page_tables, kv_lens, cu_q_lens, num_seqs,
+                               lora_tok, fsm0, gidx, bias_tab, next_tab):
+                """``_verify`` with the structured-outputs glue fused in: per
+                packed position, gather the row's grammar bias at its CURRENT
+                FSM state (advanced along the draft via ``next_tab``), apply
+                it before the greedy argmax, and return the would-be state
+                after each greedy token — so acceptance is computed against
+                grammar-legal tokens only and the host adopts the state at
+                the last accepted position instead of resyncing the automaton
+                (rejected tails roll back FSM state for free, exactly as
+                ``_spec_release_tail`` rolls back KV pages).
+
+                ``fsm0/gidx [B]`` are indexed by PACKED ROW (the verify
+                plan's order, same as ``sids``), not by slot: ``fsm0`` is the
+                state after the row's full committed history — its first
+                packed token is the last committed token, so position 0
+                masks with ``fsm0`` directly and position j>0 masks with
+                ``fsm0`` advanced through draft[0..j-1]. Slot 0 of both
+                tables is the zero no-op grammar: unconstrained rows gather
+                a zero bias and the f32 cast is monotonic, so their argmax
+                is bitwise the unmasked ``greedy_tokens`` result.
+                """
+                tokens_b = _bind(tokens, ("dp", "sp"))
+                positions_b = _bind(positions, ("dp", "sp"))
+                seq_slots_b = _bind(seq_slots, ("dp", "sp"))
+                hidden, cache, cnt = forward_core(
+                    cfg, params, cache, tokens_b, positions_b, seq_slots_b,
+                    page_tables, kv_lens, cu_q_lens=cu_q_lens,
+                    num_seqs=num_seqs, attn_impl=attn_fn,
+                    moe_matmul_impl=moe_impl,
+                    lora_indices=lora_tok if use_lora else None,
+                    lora_scale=lora_scale,
+                )
+                logits = unembed(cfg, params, hidden).astype(jnp.float32)  # [NT, V]
+                valid = positions >= 0  # padding rows must not touch any state
+                first = jnp.concatenate(
+                    [jnp.ones((1,), bool), seq_slots[1:] != seq_slots[:-1]])
+
+                # FSM states depend only on the INPUT draft tokens, not on the
+                # argmax results, so a scalar scan over packed positions
+                # suffices: each row's running state advances through its own
+                # draft (position j masks with the state after draft[0..j-1]).
+                def advance(st, x):
+                    tok, row, is_first, ok = x
+                    cur = jnp.where(is_first, st[row],
+                                    next_tab[gidx[row], st[row], tok])
+                    st = st.at[row].set(jnp.where(ok, cur, st[row]))
+                    return st, jnp.where(ok, cur, 0)
+
+                _, cur_states = jax.lax.scan(
+                    advance, fsm0, (tokens, seq_slots, first, valid))
+                g_rows = gidx[seq_slots]  # [NT]
+                greedy = jnp.argmax(logits + bias_tab[g_rows, cur_states],
+                                    axis=-1).astype(jnp.int32)
+                fsm_next = next_tab[g_rows, cur_states, greedy]  # [NT]
+                return greedy, fsm_next, cache, cnt
+
+            return _verify_masked
 
         def _decode_multi(params, cache, tokens, positions, page_tables, kv_lens,
                           temp, top_k, top_p, key, steps_left, lora_idx):
@@ -590,15 +658,40 @@ class LLMEngine:
             return jnp.sum(hidden.astype(jnp.float32) * valid, axis=0), cache
 
         donate = dict(donate_argnums=(1,))  # cache is donated — updated in place in HBM
-        self._unified_fn = jax.jit(_make_unified(attn), **donate)
-        # jit is lazy: the verify program only compiles on the first verify
-        # step, so spec_mode="off" engines never pay for it
-        self._verify_fn = jax.jit(_make_verify(attn), **donate)
-        self._decode_multi_fn = jax.jit(_decode_multi, **donate)
-        # lazy like _verify_fn: compiles on the first constrained fused
-        # dispatch, so unconstrained serving never pays for the masked program
-        self._decode_multi_masked_fn = jax.jit(_decode_multi_masked, **donate)
-        self._embed_fn = jax.jit(_embed, **donate)
+        # Step-program registry (engine/programs.py): every compiled program
+        # is a declarative entry. Routable entries carry an eligibility
+        # predicate + run hook (registration order = priority; step() is just
+        # `route(self).run(self)`); variants without one (masked/ring, embed)
+        # are dispatched BY a routable program. jax.jit is lazy throughout —
+        # registering costs nothing until a program's first dispatch, so
+        # spec_mode="off" engines never compile the verify programs and
+        # unconstrained serving never compiles the masked ones. The engine
+        # keeps its `self._*_fn` aliases: tests and the hot-path linter key
+        # on the `self._*_fn(...)` call spelling.
+        self.programs = ProgramRegistry(
+            on_dispatch=lambda name:
+                self.metrics.program_dispatches.labels(program=name).inc())
+        _register = self.programs.register
+        self._unified_fn = _register(
+            "unified", jax.jit(_make_unified(attn), **donate), attn="mixed",
+            eligible=LLMEngine._unified_eligible,
+            run=LLMEngine._run_unified_program)
+        self._verify_fn = _register(
+            "verify", jax.jit(_make_verify(attn), **donate), attn="mixed",
+            eligible=lambda eng: eng.cfg.spec_mode == "ngram",
+            run=LLMEngine._run_verify_program)
+        self._verify_masked_fn = _register(
+            "verify_masked", jax.jit(_make_verify_masked(attn), **donate),
+            attn="mixed")
+        self._decode_multi_fn = _register(
+            "decode", jax.jit(_decode_multi, **donate), attn="decode",
+            eligible=lambda eng: True,  # terminal entry: always routable
+            run=LLMEngine._run_decode_program)
+        self._decode_multi_masked_fn = _register(
+            "decode_masked", jax.jit(_decode_multi_masked, **donate),
+            attn="decode")
+        self._embed_fn = _register("embed", jax.jit(_embed, **donate),
+                                   attn="mixed")
 
         # "attn" step-phase probe: a jitted attention-ONLY call at the live
         # decode shape (real pool, layer-0 page tables), run every
@@ -645,7 +738,9 @@ class LLMEngine:
             layout = "zigzag" if NT % (2 * engine_cfg.mesh.sp) == 0 else "contiguous"
             ring = make_ring_attn_impl(mesh, axis_name="sp",
                                        zigzag=(layout == "zigzag"))
-            self._unified_ring_fn = jax.jit(_make_unified(ring), **donate)
+            self._unified_ring_fn = _register(
+                "unified_ring", jax.jit(_make_unified(ring), **donate),
+                attn="mixed")
             self.sp_attn_backend = f"ring_{layout}(sp={engine_cfg.mesh.sp})"
             self.stats.sp_attn_backend = self.sp_attn_backend
 
@@ -662,7 +757,8 @@ class LLMEngine:
             # supported head sizes; the XLA impl handles the mixed-batch
             # programs (unified/verify/embed) at any width. The fused-decode
             # program upgrades to the latent-width Pallas kernel in
-            # _select_decode_attn_impl — decode is where the KV stream lives.
+            # programs.select_decode_attn_impl — decode is where the KV
+            # stream lives.
             # xla_mla_absorbed is the DESIGNED mixed-batch backend for MLA,
             # not a degradation — provenance lives in attn_backend alone so
             # fallback alerts stay quiet on healthy MLA engines
@@ -709,56 +805,9 @@ class LLMEngine:
             self.attn_fallback_reason = f"pallas smoke-compile failed: {type(e).__name__}: {e}"
             return ragged_paged_attention_xla
 
-    def _select_decode_attn_impl(self, unified_attn):
-        """Attention impl for the FUSED-DECODE program only.
-
-        GQA engines share the unified impl (the ragged Pallas kernel already
-        serves mixed batches). MLA engines upgrade to the latent-width Pallas
-        decode kernel (`ops.mla_decode`): the fused-decode batch is exactly
-        its shape — one query row per slot over the single-plane latent pool —
-        while unified/verify/embed (mixed chunk shapes) keep the XLA absorbed
-        reference. On success ``attn_backend`` becomes
-        ``pallas_mla_latent_decode`` and ``attn_fallback_reason`` stays None.
-
-        `attn_impl` semantics on MLA: "auto" takes the kernel on TPU only
-        (interpreter-mode Pallas is orders of magnitude slower than the XLA
-        reference on CPU meshes); explicit "pallas" forces it anywhere —
-        interpret mode off-TPU — and raises on smoke-compile failure, the
-        same hard guarantee the explicit mode carries for GQA; "reference"
-        keeps the XLA impl everywhere.
-        """
-        if not self.model_cfg.is_mla:
-            return unified_attn
-        mode = self.cfg.attn_impl
-        if mode == "reference":
-            return unified_attn
-        if mode == "auto" and jax.default_backend() != "tpu":
-            return unified_attn
-        from llmd_tpu.ops.mla_decode import mla_paged_attention_latent
-
-        try:  # smoke-compile tiny decode shapes so a Mosaic failure can't strand serving
-            c = self.model_cfg
-            dhp = self.cache.shape[-1]  # padded latent width == pool lane width
-            ps = self.cfg.page_size
-            q = jnp.zeros((1, c.num_heads, dhp), c.jax_dtype)
-            cache = jnp.zeros((2, ps, 1, dhp), self.kv_dtype)
-            mla_paged_attention_latent(
-                q, cache, jnp.zeros((1, 2), jnp.int32),
-                jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
-                jnp.ones((1,), jnp.int32),
-                scale=(c.mla_qk_nope_dim + c.mla_rope_dim) ** -0.5,
-                cu_q_lens=jnp.array([0, 1], jnp.int32),
-                num_seqs=jnp.array([1], jnp.int32),
-            ).block_until_ready()
-            self.attn_backend = "pallas_mla_latent_decode"
-            self.attn_fallback_reason = None
-            return mla_paged_attention_latent
-        except Exception as e:  # noqa: BLE001 — any Mosaic/XLA compile error
-            if mode == "pallas":
-                raise
-            self.attn_fallback_reason = (
-                f"mla latent decode smoke-compile failed: {type(e).__name__}: {e}")
-            return unified_attn
+    # (the fused-decode attention-impl selector moved to
+    # llmd_tpu.engine.programs.select_decode_attn_impl — it is step-program
+    # metadata, resolved once at startup before the programs are registered)
 
     def _select_moe_impl(self):
         """Pick the MoE expert-GEMM path: Pallas grouped GEMM on TPU (after a smoke
@@ -1365,37 +1414,16 @@ class LLMEngine:
 
     # --------------------------------------------------------------- stepping
     def step(self) -> list[EngineOutput]:
-        """One engine iteration: admit → unified mixed step (while any sequence is
-        prefilling) or fused multi-step decode."""
+        """One engine iteration: admit, then run the first eligible step
+        program (engine/programs.py registration order: unified while any
+        sequence is prefilling or a constrained row needs the unified
+        degrade, speculative verify when spec_mode="ngram", fused decode
+        otherwise)."""
         self._outputs = []
         if self.offload is not None:
             self._offload_drain()
         self._try_admit()
-        if self._prefilling_seqs():
-            # the mixed step reads host token state — apply any in-flight decode first
-            self._flush_pending_decode()
-            self._step_unified()
-        elif (any(s is not None and (s.structured is not None or s.logit_bias)
-                  for s in self.running)
-              and self._constrained_needs_unified()):
-            # Constrained rows (grammar mask / logit_bias) normally ride the
-            # masked fused decode program — bias gather, biased sample, and
-            # FSM transition all on-device (_decode_multi_masked). The 1-token
-            # unified degrade (host-built bias + _sample_dispatch) remains for
-            # the cases the dense-table scheme can't express: the knob off, a
-            # row combining grammar AND logit_bias, or tables past the
-            # structured_table_max_elems gate. Spec verify never sees
-            # constrained rows either way (_spec_try_verify guards).
-            self._flush_pending_decode()
-            self._step_unified()
-        else:
-            # decode builds its batch from host token state: the deferred
-            # prefill sample (first tokens) must land first
-            self._flush_pending_sample()
-            # speculation gate: a verify step replaces this step's fused
-            # decode call when prompt-lookup drafts exist (spec_mode="ngram")
-            if not (self.cfg.spec_mode == "ngram" and self._spec_try_verify()):
-                self._step_decode()
+        self.programs.route(self).run(self)
         self.stats.num_waiting = sum(len(q) for q in self.waitq)
         self.stats.num_running = sum(1 for s in self.running if s is not None)
         self.stats.kv_utilization = (
@@ -1409,6 +1437,41 @@ class LLMEngine:
         if self._eplb is not None:
             self._eplb_tick()
         return self._outputs
+
+    # ------------------------------------------------- step-program run hooks
+    # Eligibility predicates + run hooks for the routable registry entries.
+    # route() calls them unbound (spec.eligible(engine) / spec.run(engine)),
+    # so a custom program registered by a test or a future subsystem can pass
+    # any callable of the same shape — adding a program is one registry entry.
+
+    def _unified_eligible(self) -> bool:
+        """The unified mixed step serves prefill chunks, and remains the
+        1-token degrade for constrained rows the dense-table scheme can't
+        express (structured_fused_decode off, a row combining grammar AND
+        logit_bias, or tables past the structured_table_max_elems gate)."""
+        if self._prefilling_seqs():
+            return True
+        return (any(s is not None and (s.structured is not None or s.logit_bias)
+                    for s in self.running)
+                and self._constrained_needs_unified())
+
+    def _run_unified_program(self) -> None:
+        # the mixed step reads host token state — apply any in-flight decode first
+        self._flush_pending_decode()
+        self._step_unified()
+
+    def _run_verify_program(self) -> None:
+        # decode/verify build their batch from host token state: the deferred
+        # prefill sample (first tokens) must land first
+        self._flush_pending_sample()
+        # a verify step replaces this step's fused decode call when
+        # prompt-lookup drafts exist; otherwise fall through to fused decode
+        if not self._spec_try_verify():
+            self._step_decode()
+
+    def _run_decode_program(self) -> None:
+        self._flush_pending_sample()
+        self._step_decode()
 
     def _emit_step_spans(self, phase: str, seqs: list[Sequence],
                          start_ns: int, batch_size: int, n_tokens: int) -> None:
@@ -1583,12 +1646,16 @@ class LLMEngine:
         # (positions 0..n-1, no prior KV) — the only regime where causality by
         # row index equals causality by position and in-chunk q/k/v are the
         # whole attention problem (see make_ring_attn_impl)
-        step_fn = self._unified_fn
+        step_fn, step_prog = self._unified_fn, "unified"
         if (self._unified_ring_fn is not None and len(plan) == 1
                 and not plan[0][2] and plan[0][0].num_computed == 0
                 and pos[0] == 0 and not is_vl):
-            step_fn = self._unified_ring_fn
+            step_fn, step_prog = self._unified_ring_fn, "unified_ring"
             self.stats.n_ring_prefill_steps += 1
+        # synchronous program: the postprocess below consumes the logits this
+        # same step, so dispatch and completion are recorded together
+        self.programs.record_dispatch(step_prog)
+        self.programs.record_complete(step_prog)
         logits, self.cache, cnt = step_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(cu),
@@ -1766,24 +1833,56 @@ class LLMEngine:
                                       lead_request=s.request_id)
 
     # ------------------------------------------------------------ speculation
+    def _verify_nt(self) -> int:
+        """Static packed width of the verify programs. Every draft is clamped
+        to ``spec_tokens`` (``_spec_propose``), so ``max_batch_size *
+        (spec_tokens + 1)`` positions always hold the worst-case plan —
+        padding verify to the full prefill width (``batched_tokens``) would
+        pay a prefill-sized forward to land a handful of tokens per row
+        (6.4x waste at the tiny smoke shape: 40 real positions in NT=256)."""
+        return min(self.cfg.batched_tokens,
+                   self.cfg.max_batch_size * (self.cfg.spec_tokens + 1))
+
     def _spec_propose(self, s: Sequence, max_draft: int) -> list[int]:
         """Prompt-lookup draft for one decode-ready seq, clamped so the
         verify step can land every accepted token: k drafts + 1 bonus token
         may append, so k is bounded by the remaining max_tokens /
         max_model_len budget minus one (the bonus token is the plain-decode
-        token and is always in budget)."""
-        if s.structured is not None or s.logit_bias:
-            # constrained rows never draft: the verify program samples
-            # greedily on-device without the grammar mask / bias
-            return []
+        token and is always in budget). Constrained rows draft too
+        (spec × structured compose, PERF.md Lever 13): their proposal is
+        trimmed to its longest constraint-legal prefix, so the masked verify
+        program only ever checks tokens the grammar could emit."""
         k = min(self.cfg.spec_tokens, max_draft,
                 s.max_tokens - s.num_generated - 1,
                 self.cfg.max_model_len - len(s.token_ids) - 1)
         if k <= 0:
             return []
         draft = propose_ngram_draft(s.token_ids, k, self.cfg.spec_ngram_max,
-                                    self.cfg.spec_ngram_min)
-        return draft[:k]
+                                    self.cfg.spec_ngram_min)[:k]
+        if draft and (s.structured is not None or s.logit_bias):
+            draft = self._spec_filter_draft(s, draft)
+        return draft
+
+    def _spec_filter_draft(self, s: Sequence, draft: list[int]) -> list[int]:
+        """FSM-aware draft truncation for a constrained row: keep the longest
+        prefix of ``draft`` its constraint allows. Grammar rows walk the host
+        automaton from the synced cursor (an idempotent ``sync`` first — the
+        cursor must reflect every committed token before extrapolating);
+        logit_bias rows cut at the first effectively-banned token. Returns []
+        when spec_structured is off (legacy: constrained rows never draft)."""
+        if not self.cfg.spec_structured:
+            return []
+        stt = s.structured
+        if stt is not None:
+            fresh = stt.sync(s.token_ids, s.prompt_len)
+            if fresh:
+                self.stats.structured_violations += fresh
+                self.metrics.structured_violations.inc(fresh)
+            return draft[:stt.grammar.legal_prefix_len(stt.state, draft)]
+        for i, t in enumerate(draft):
+            if s.logit_bias.get(t, 0.0) <= -100.0:
+                return draft[:i]
+        return draft
 
     def _spec_try_verify(self) -> bool:
         """Decode-path speculation gate; True = a verify step ran (replacing
@@ -1800,32 +1899,41 @@ class LLMEngine:
         active = self._decode_ready()
         if not active:
             return False
-        # The verify program samples unmasked greedy at every packed position:
-        # constrained rows must never ride it. Reachable now that constrained
-        # batches decode through the fused masked program instead of the
-        # unified degrade (which used to shadow this gate entirely).
+        # Constrained rows ride verify ONLY through the masked verify program
+        # (grammar bias + FSM advance fused per packed position). When the
+        # compose knob is off, or the batch's mask plan is inexpressible as
+        # dense tables (combined grammar+bias row, table-size gate), the
+        # batch falls back to the fused decode path, which has its own
+        # masked/degrade handling.
         if any(s.structured is not None or s.logit_bias for s in active):
-            return False
+            if not (self.cfg.spec_structured
+                    and self._plan_chain_masks(active) is not None):
+                return False
         # Greedy acceptance is only bitwise-equivalent to sequential decoding
         # for greedy rows; a batch with sampled sequences falls back to the
         # fused decode path.
         if any(s.sampling.temperature > 0.0 for s in active):
             return False
-        # Probe arming: the drafter is a pure function of each row's token
-        # history, so a no-match verdict stays valid until fresh tokens land
-        # (_decode_process / _sample_apply / a verify step re-arm). Skipping
-        # the re-probe drops the per-step O(context) numpy scans from the
-        # chained steady state.
-        if not self._spec_armed:
-            return False
-        if not any(self._spec_propose(s, self.cfg.spec_tokens) for s in active):
-            self._spec_armed = False
+        # Probe arming (per sequence): the drafter is a pure function of each
+        # row's token history, so a no-match verdict stays valid until fresh
+        # tokens land for that row (_decode_process / _sample_apply / a
+        # verify step re-arm it). Skipping the re-probe drops the per-step
+        # O(context) numpy scans from the chained steady state — and one
+        # non-repetitive row no longer disarms the rest of the batch.
+        probed = False
+        for s in active:
+            if s.spec_armed:
+                if self._spec_propose(s, self.cfg.spec_tokens):
+                    probed = True
+                else:
+                    s.spec_armed = False
+        if not probed:
             return False
         self._flush_pending_decode()
         active = [s for s in self._decode_ready() if s.slot >= 0]
         if not active:
             return True  # the flush retired/changed the batch; step done
-        NT = self.cfg.batched_tokens
+        NT = self._verify_nt()
         R = self.num_ranks
         # every active row is guaranteed its plain token (batched_tokens >=
         # max_batch_size); drafts share the leftover per-rank budget
@@ -1838,7 +1946,8 @@ class LLMEngine:
                 break
             if s.slot < 0:
                 continue  # preempted while packing an earlier row
-            draft = self._spec_propose(s, max(0, spare[s.rank]))
+            draft = (self._spec_propose(s, max(0, spare[s.rank]))
+                     if s.spec_armed else [])
             if draft and not self._ensure_pages(s, len(s.token_ids) + len(draft)):
                 draft = []  # shed the draft before shedding a sequence
             if not self._ensure_pages(s, len(s.token_ids)):
@@ -1850,10 +1959,18 @@ class LLMEngine:
             plan.append((s, draft))
             spare[s.rank] -= len(draft)
         plan = [(s, d) for s, d in plan if s.slot >= 0]
+        if any(s.structured is not None or s.logit_bias for s, _ in plan):
+            # a constrained row may have become decode-ready during the flush:
+            # re-check masked-verify eligibility on the FINAL plan — an
+            # ineligible row must never ride the unmasked verify program
+            if not (self.cfg.spec_structured and self._plan_chain_masks(
+                    [s for s, _ in plan]) is not None):
+                return False
         if not any(d for _, d in plan):
             # fresh state proposes nothing: plain decode instead — and no
-            # re-probe until the next landing changes that state
-            self._spec_armed = False
+            # re-probe for these rows until the next landing changes that
+            for s, _ in plan:
+                s.spec_armed = False
             return False
         self._step_spec_verify(plan)
         return True
@@ -1869,7 +1986,7 @@ class LLMEngine:
         back to the allocator's free list."""
         t0 = time.perf_counter()
         t0_ns = time.time_ns()
-        NT = self.cfg.batched_tokens
+        NT = self._verify_nt()
         B = self.cfg.max_batch_size
         toks = np.zeros((NT,), np.int32)
         pos = np.full((NT,), -1, np.int32)
@@ -1893,6 +2010,8 @@ class LLMEngine:
             if draft:
                 s.spec_drafted += len(draft)
                 self.stats.spec_drafted += len(draft)
+                if s.structured is not None or s.logit_bias:
+                    self.stats.spec_drafted_constrained += len(draft)
                 self.metrics.spec_drafted.inc(len(draft))
                 self.flight.record(s.request_id, "spec_draft",
                                    drafted=len(draft))
@@ -1900,15 +2019,36 @@ class LLMEngine:
             off += n
             cu[i + 1] = off
         cu[len(plan) + 1 :] = off
+        tm = time.perf_counter()
+        # constrained rows ride the masked variant: dense [G,S,V] bias/next
+        # tables + per-packed-row FSM entry states (None = no constrained
+        # row). Stage wall self-accounts into time_mask_build, so the pack
+        # split below stops at tm — the two stats stay disjoint.
+        mask = self._spec_stage_verify_masks(plan)
+        prog = "verify" if mask is None else "verify_masked"
         t1 = time.perf_counter()
-        greedy, self.cache, cnt = self._verify_fn(
-            self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens),
-            jnp.asarray(cu), jnp.asarray([len(plan)], jnp.int32),
-            jnp.asarray(lora_tok),
-        )
+        self.programs.record_dispatch(prog)
+        if mask is None:
+            fsm_out = None
+            greedy, self.cache, cnt = self._verify_fn(
+                self._run_params(), self.cache, jnp.asarray(toks),
+                jnp.asarray(pos), jnp.asarray(sids), jnp.asarray(pts),
+                jnp.asarray(lens), jnp.asarray(cu),
+                jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok),
+            )
+        else:
+            greedy, fsm_out, self.cache, cnt = self._verify_masked_fn(
+                self._run_params(), self.cache, jnp.asarray(toks),
+                jnp.asarray(pos), jnp.asarray(sids), jnp.asarray(pts),
+                jnp.asarray(lens), jnp.asarray(cu),
+                jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok),
+                mask["fsm0"], mask["gidx"], mask["bias_tab"], mask["next_tab"],
+            )
         # llmd-lint: allow[hot-host-sync] designed sync point: verify needs the greedy tokens on host to accept/reject the draft
         g = np.asarray(greedy)  # [NT] (device sync point)
+        # llmd-lint: allow[hot-host-sync] same designed sync point: the per-position FSM states ride the readback the greedy tokens already paid for
+        fsm = np.asarray(fsm_out) if fsm_out is not None else None
+        self.programs.record_complete(prog)
         t2 = time.perf_counter()
         if self._eplb is not None:
             self._eplb_record(cnt)
@@ -1941,10 +2081,34 @@ class LLMEngine:
                     ttft_ms=round((now - s.arrival_time) * 1e3, 3))
             s.maybe_commit_blocks(self.allocs[s.rank])
             self._spec_release_tail(s)
+            constrained = s.structured is not None or bool(s.logit_bias)
+            if fsm is not None and s.structured is not None:
+                stt = s.structured
+                dev_state = int(fsm[row0 + len(kept) - 1])
+                if self.cfg.spec_structured_crosscheck:
+                    # recovery path kept honest: re-derive the cursor on host
+                    # from the accepted tokens and compare with the device
+                    # state; a mismatch keeps the host value (and is a bug)
+                    fresh = stt.sync(s.token_ids, s.prompt_len)
+                    if fresh:
+                        self.stats.structured_violations += fresh
+                        self.metrics.structured_violations.inc(fresh)
+                    if stt.state != dev_state:
+                        self.stats.spec_fsm_crosscheck_mismatches += 1
+                else:
+                    # the state at the last kept position IS the
+                    # post-acceptance automaton state: rejected tails rolled
+                    # back for free, exactly as _spec_release_tail rolls back
+                    # their KV pages. Adopt it in place of the host resync.
+                    stt.state = dev_state
+                    stt.n_seen = len(s.token_ids) - s.prompt_len
             s.spec_accepted += accepted
+            s.spec_armed = True  # fresh tokens landed for this row: re-probe
             st = self.stats
             st.spec_accepted += accepted
             st.spec_rejected += rejected
+            if constrained:
+                st.spec_accepted_constrained += accepted
             st.total_decode_tokens += len(kept)
             n_tokens += len(kept)
             if accepted:
@@ -1955,6 +2119,7 @@ class LLMEngine:
                 self.flight.record(s.request_id, "spec_verify",
                                    drafted=len(draft), accepted=accepted,
                                    n_tokens=len(kept),
+                                   constrained=constrained,
                                    generated=s.num_generated)
             else:
                 self.flight.record(s.request_id, "decode", n_tokens=len(kept),
@@ -1969,14 +2134,13 @@ class LLMEngine:
             ))
         t3 = time.perf_counter()
         st = self.stats
-        st.time_host_pack += t1 - t0
+        st.time_host_pack += tm - t0
         st.time_device += t2 - t1
         st.time_postprocess += t3 - t2
         st.time_spec_steps += t3 - t0
         st.n_spec_verify_steps += 1
         if n_tokens:
             self.metrics.decode_tokens.inc(n_tokens)
-            self._spec_armed = True  # fresh tokens landed: re-probe next step
         self.metrics.step_duration.labels(phase="spec_verify").observe(
             t3 - t0, exemplar=self._trace_exemplar([s for s, _, _, _ in rows]))
         self._emit_step_spans("spec_verify", [s for s, _, _, _ in rows], t0_ns,
@@ -2068,40 +2232,10 @@ class LLMEngine:
             return None
         t0 = time.perf_counter()
         B = self.cfg.max_batch_size
-        G_pad, S_pad, V = plan["G_pad"], plan["S_pad"], plan["V"]
-        cache_key = (plan["key"], G_pad, S_pad)
-        hit = self._mask_tab_cache.get(cache_key)
-        if hit is not None:
-            self._mask_tab_cache.move_to_end(cache_key)
-            bias_dev, next_dev, gidx_dev, _pins = hit
-        else:
-            bias_tab = np.zeros((G_pad, S_pad, V), np.float32)
-            next_tab = np.zeros((G_pad, S_pad, V), np.int32)
-            pins = []
-            for gi, (kind, payload) in enumerate(plan["entries"], start=1):
-                if kind == "g":
-                    g = payload
-                    pins.append(g)
-                    b, nx = g.dense_tables()
-                    S = g.n_states
-                    bias_tab[gi, :S] = b
-                    next_tab[gi, :S] = nx
-                else:  # logit_bias row: state pinned at 0 (next stays 0)
-                    row = bias_tab[gi, 0]
-                    for tid, bval in payload:
-                        if 0 <= tid < V:
-                            # OpenAI semantics: -100 is an outright ban
-                            row[tid] = (NEG_BIAS if bval <= -100.0
-                                        else row[tid] + bval)
-            gidx = np.zeros((B,), np.int32)
-            for s, gi in plan["rows"]:
-                gidx[s.slot] = gi
-            bias_dev, next_dev = jnp.asarray(bias_tab), jnp.asarray(next_tab)
-            gidx_dev = jnp.asarray(gidx)
-            self._mask_tab_cache[cache_key] = (bias_dev, next_dev, gidx_dev,
-                                               tuple(pins))
-            while len(self._mask_tab_cache) > 8:
-                self._mask_tab_cache.popitem(last=False)
+        bias_dev, next_dev = self._mask_tables(plan)
+        gidx = np.zeros((B,), np.int32)
+        for s, gi in plan["rows"]:
+            gidx[s.slot] = gi
         fsm0 = np.zeros((B,), np.int32)
         for s, _gi in plan["rows"]:
             stt = s.structured
@@ -2122,8 +2256,84 @@ class LLMEngine:
         self.stats.structured_chain_stages += 1
         self.metrics.structured_mask_seconds.observe(dt)
         self.metrics.step_duration.labels(phase="chain_stage").observe(dt)
-        return {"bias_tab": bias_dev, "next_tab": next_dev, "gidx": gidx_dev,
-                "fsm0": jnp.asarray(fsm0)}
+        return {"bias_tab": bias_dev, "next_tab": next_dev,
+                "gidx": jnp.asarray(gidx), "fsm0": jnp.asarray(fsm0)}
+
+    def _mask_tables(self, plan: dict) -> tuple:
+        """Staged dense ``[G_pad, S_pad, V]`` bias/next tables for a mask
+        plan, LRU-cached across chains AND verify steps (the key carries the
+        participating constraints + pad shape; an entry pins its grammar
+        objects so an id-keyed slot can never be reused by a different
+        grammar while staged). Row-index vectors are NOT cached — the fused
+        chain indexes by slot, the masked verify by packed row."""
+        cache_key = (plan["key"], plan["G_pad"], plan["S_pad"])
+        hit = self._mask_tab_cache.get(cache_key)
+        if hit is not None:
+            self._mask_tab_cache.move_to_end(cache_key)
+            return hit[0], hit[1]
+        G_pad, S_pad, V = plan["G_pad"], plan["S_pad"], plan["V"]
+        bias_tab = np.zeros((G_pad, S_pad, V), np.float32)
+        next_tab = np.zeros((G_pad, S_pad, V), np.int32)
+        pins = []
+        for gi, (kind, payload) in enumerate(plan["entries"], start=1):
+            if kind == "g":
+                g = payload
+                pins.append(g)
+                b, nx = g.dense_tables()
+                S = g.n_states
+                bias_tab[gi, :S] = b
+                next_tab[gi, :S] = nx
+            else:  # logit_bias row: state pinned at 0 (next stays 0)
+                row = bias_tab[gi, 0]
+                for tid, bval in payload:
+                    if 0 <= tid < V:
+                        # OpenAI semantics: -100 is an outright ban
+                        row[tid] = (NEG_BIAS if bval <= -100.0
+                                    else row[tid] + bval)
+        bias_dev, next_dev = jnp.asarray(bias_tab), jnp.asarray(next_tab)
+        self._mask_tab_cache[cache_key] = (bias_dev, next_dev, tuple(pins))
+        while len(self._mask_tab_cache) > 8:
+            self._mask_tab_cache.popitem(last=False)
+        return bias_dev, next_dev
+
+    def _spec_stage_verify_masks(self, plan) -> Optional[dict]:
+        """Mask staging for one MASKED verify step: the same shared dense
+        tables as the fused chain (same LRU), plus ``gidx``/``fsm0`` indexed
+        by PACKED ROW (the verify plan's order — ``sids`` values), not by
+        slot. ``fsm0`` is each constrained row's synced automaton state over
+        its full committed history; padding rows keep gidx/fsm0 = 0 (the
+        zero no-op grammar) and the program's validity mask stops them from
+        touching any real row's state. Returns None when no row in the plan
+        is constrained — the plain verify program serves it."""
+        seqs = [s for s, _ in plan]
+        if not any(s.structured is not None or s.logit_bias for s in seqs):
+            return None
+        mplan = self._plan_chain_masks(seqs)
+        if mplan is None:
+            return None  # raced: _spec_try_verify re-checks before dispatch
+        t0 = time.perf_counter()
+        B = self.cfg.max_batch_size
+        bias_dev, next_dev = self._mask_tables(mplan)
+        slot_of = {id(s): gi for s, gi in mplan["rows"]}
+        gidx = np.zeros((B,), np.int32)
+        fsm0 = np.zeros((B,), np.int32)
+        for i, (s, _draft) in enumerate(plan):
+            gi = slot_of.get(id(s))
+            if gi is None:
+                continue  # unconstrained row: zero no-op grammar
+            gidx[i] = gi
+            stt = s.structured
+            if stt is not None:
+                fresh = stt.sync(s.token_ids, s.prompt_len)
+                if fresh:
+                    self.stats.structured_violations += fresh
+                    self.metrics.structured_violations.inc(fresh)
+                fsm0[i] = stt.state
+        dt = time.perf_counter() - t0
+        self.stats.time_mask_build += dt
+        self.metrics.structured_mask_seconds.observe(dt)
+        return {"bias_tab": bias_dev, "next_tab": next_dev,
+                "gidx": jnp.asarray(gidx), "fsm0": jnp.asarray(fsm0)}
 
     def _pack_buf(self) -> dict[str, np.ndarray]:
         """Rotated host-pack buffer set for the chained fast path. There are
@@ -2269,6 +2479,8 @@ class LLMEngine:
             fsm_out = None
         self.stats.time_decode_steps += time.perf_counter() - wall_start
         self.stats.n_decode_dispatches += 1
+        prog = "decode" if mask is None else "decode_masked"
+        self.programs.record_dispatch(prog)
         if chain is not None:
             self.stats.n_chained_dispatches += 1
         self.metrics.step_duration.labels(phase="decode_dispatch").observe(
@@ -2291,7 +2503,7 @@ class LLMEngine:
             except (AttributeError, RuntimeError):
                 break
         return {
-            "rows": [(s, s.slot) for s in active],
+            "rows": [(s, s.slot) for s in active], "prog": prog,
             "toks_out": toks_out, "last_toks": last_toks, "cnt": cnt, "k": k,
             # device-resident chain point for the next pipelined dispatch
             "pos_out": pos_out, "lens_out": lens_out, "fsm_out": fsm_out,
@@ -2362,6 +2574,8 @@ class LLMEngine:
             s.maybe_commit_blocks(self.allocs[s.rank])
             self.stats.total_decode_tokens += len(kept)
             self.stats.decode_tokens_fused += len(kept)
+            if kept:
+                s.spec_armed = True  # fresh tokens landed: re-probe this row
             n_tokens += len(kept)
             # one progress event per fused k-step call (per-N decode progress)
             self.flight.record(s.request_id, "decode", n_tokens=len(kept),
@@ -2380,9 +2594,9 @@ class LLMEngine:
         st.time_postprocess += t3 - t2
         st.time_decode_steps += t3 - t1
         st.n_decode_calls += 1
+        self.programs.record_complete(rec["prog"])
         if n_tokens:
             self.metrics.decode_tokens.inc(n_tokens)
-            self._spec_armed = True  # fresh tokens landed: re-probe the drafter
         self.metrics.step_duration.labels(phase="decode_process").observe(
             t3 - t1, exemplar=self._trace_exemplar([s for s, _ in rec["rows"]]))
         self._emit_step_spans("decode", [s for s, _ in rec["rows"]], t1_ns,
@@ -2402,7 +2616,9 @@ class LLMEngine:
                 self.stats.structured_violations += n_bad
                 self.metrics.structured_violations.inc(n_bad)
         if seq.spec_drafted > 0:
-            self.metrics.spec_acceptance.observe(
+            constrained = seq.structured is not None or bool(seq.logit_bias)
+            self.metrics.spec_acceptance.labels(
+                constrained="yes" if constrained else "no").observe(
                 seq.spec_accepted / seq.spec_drafted)
         self.flight.finish(
             seq.request_id, event="retired", reason=reason or "",
@@ -2526,6 +2742,7 @@ class LLMEngine:
             sampled.copy_to_host_async()
         except (AttributeError, RuntimeError):
             pass
+        self.programs.record_dispatch("sample")
         return {"sampled": sampled,
                 "rows": [(i, s, s.slot) for i, s in rows_and_seqs]}
 
@@ -2538,13 +2755,14 @@ class LLMEngine:
         """Read one dispatched sample's tokens (device sync point) and apply."""
         # llmd-lint: allow[hot-host-sync] designed sync point: deferred sample readback, overlapped with the next dispatch
         sampled = np.asarray(rec["sampled"])
+        self.programs.record_complete("sample")
         now = time.monotonic()
         for i, s, slot in rec["rows"]:
             if s.finished or s.slot != slot or self.running[slot] is not s:
                 continue  # aborted / preempted while the sample was in flight
             tok = int(sampled[i])
             s.token_ids.append(tok)
-            self._spec_armed = True  # fresh token landed: re-probe the drafter
+            s.spec_armed = True  # fresh token landed: re-probe this row's drafter
             if s.structured is not None:
                 fresh = s.structured.sync(s.token_ids, s.prompt_len)
                 if fresh:  # masked sampling should make this unreachable
@@ -2645,4 +2863,8 @@ class LLMEngine:
             f"{self.stats.n_decode_dispatches} "
             f"processed={self.stats.n_decode_calls} "
             f"pending={len(self._pending_decode)}")
+        # generalized form (programs.py): the per-program ledger must balance
+        # for EVERY registry entry at every drain, not just the decode pair
+        assert self.programs.quiesced(), (
+            f"program ledger leak at quiesce: {self.programs.counters()}")
         return done

@@ -253,6 +253,11 @@ class Sequence:
     # per-request acceptance-rate summary observed at retirement.
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Per-sequence draft arming: the prompt-lookup probe is O(context) host
+    # work, so a row whose probe came up empty stays disarmed until fresh
+    # tokens land for IT (decode/sample/verify). Per-sequence — one
+    # non-repetitive stream must not disarm drafting for the whole batch.
+    spec_armed: bool = True
     # Structured outputs (llmd_tpu/structured): the per-sequence automaton
     # cursor (StructuredState) when the request is grammar-constrained. The
     # cursor derives from token_ids, which preemption preserves, so recompute

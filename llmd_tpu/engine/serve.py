@@ -107,6 +107,20 @@ def main() -> None:
                                                str(1 << 23))),
                     help="max staged mask-table size (G_pad*S_pad*V elements) "
                          "before constrained rows degrade to unified steps")
+    ap.add_argument("--spec-structured",
+                    default=os.environ.get("LLMD_SPEC_STRUCTURED", "on"),
+                    choices=["on", "off"],
+                    help="constrained rows compose with speculation: drafts "
+                         "truncate to their grammar-legal prefix and verify "
+                         "through the grammar-masked verify program; 'off' "
+                         "restores the legacy never-draft behavior")
+    ap.add_argument("--spec-structured-crosscheck",
+                    default=os.environ.get("LLMD_SPEC_STRUCTURED_CROSSCHECK",
+                                           "off"),
+                    choices=["on", "off"],
+                    help="debug: re-derive FSM state on host after every "
+                         "masked verify step and compare with the device "
+                         "state (mismatches adopt the host value)")
     ap.add_argument("--enable-lora", action="store_true",
                     help="enable dynamic LoRA adapter serving")
     ap.add_argument("--max-loras", type=int, default=8)
@@ -170,6 +184,8 @@ def main() -> None:
         pack_overlap=args.pack_overlap == "on",
         structured_fused_decode=args.structured_fused == "on",
         structured_table_max_elems=args.structured_table_elems,
+        spec_structured=args.spec_structured == "on",
+        spec_structured_crosscheck=args.spec_structured_crosscheck == "on",
     )
     if args.enable_lora:
         from llmd_tpu.models.lora import LoRAConfig

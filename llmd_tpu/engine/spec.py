@@ -9,7 +9,10 @@ Longer n-grams are tried first (``spec_ngram_max`` down to
 continuation; the first hit wins. Verification happens in the engine's flat
 mixed-batch program (engine.py), where greedy acceptance keeps output
 bitwise identical to non-speculative decoding — the drafter only has to be
-*useful*, never *correct*.
+*useful*, never *correct*. Constrained rows (grammar masks / logit_bias)
+are drafted the same way; the engine then trims the proposal to its longest
+constraint-legal prefix (``LLMEngine._spec_filter_draft``) before the
+grammar-masked verify program checks it.
 
 This pays exactly on the traffic the ROADMAP north-star targets: shared
 prefixes, agentic tool loops, and summarization, where the output echoes

@@ -561,7 +561,17 @@ class EngineMetrics:
             "Draft tokens rejected (rolled back) by greedy verification")
         self.spec_acceptance = reg.summary(
             "llmd_tpu:spec_acceptance_rate",
-            "Per-request draft acceptance rate, observed at retirement")
+            "Per-request draft acceptance rate, observed at retirement "
+            "(constrained=yes for grammar/logit_bias rows — the spec x "
+            "structured compose path)",
+            labelnames=("constrained",))
+        # Step-program registry (engine/programs.py): per-program dispatch
+        # counts; paired with the registry's completion counters they carry
+        # the generalized quiesce invariant into /metrics.
+        self.program_dispatches = reg.counter(
+            "llmd_tpu:engine_program_dispatches_total",
+            "Compiled-program dispatches, by step-program registry entry",
+            labelnames=("program",))
         # Structured outputs (llmd_tpu/structured): grammar-constrained
         # decoding with on-device logit masks.
         self.structured_requests = reg.counter(

@@ -89,6 +89,20 @@ class TokenGrammar:
             return self.terminal if state in self.accept else None
         return self._next[state].get(tid)
 
+    def legal_prefix_len(self, state: int, tokens) -> int:
+        """Length of the longest prefix of ``tokens`` that stays inside the
+        grammar when consumed from ``state`` — FSM-aware draft truncation:
+        the speculative drafter keeps the legal prefix of an n-gram
+        continuation instead of skipping constrained rows outright."""
+        n = 0
+        for t in tokens:
+            nxt = self.advance(state, t)
+            if nxt is None:
+                break
+            state = nxt
+            n += 1
+        return n
+
     def allowed_ids(self, state: int) -> np.ndarray:
         return self._allowed[state]
 

@@ -126,3 +126,19 @@ def test_ci_gate_composes_stages():
         "validate-manifests", "chaos-check", "structured-check", "slo-check",
         "device-obs", "kv-plane-check", "perf-regress"]
     assert all(s["ok"] for s in summary["stages"])
+
+
+def test_ci_gate_pins_bench_stages():
+    """The bench stage roster is a contract too: every tiny-bench smoke the
+    gate promises (including the structured x speculative compose smoke,
+    PERF.md Lever 13) must stay declared in ci_gate.py. Pinned by source
+    scan because actually running the bench stages is minutes of wall."""
+    src = (ROOT / "tools" / "ci_gate.py").read_text()
+    for stage in ("bench-tiny-cpu", "bench-tiny-spec", "bench-tiny-attn",
+                  "bench-tiny-structured", "bench-tiny-spec-structured",
+                  "bench-tiny-warmstart"):
+        assert f'"{stage}"' in src, f"ci_gate.py lost bench stage {stage}"
+    # the compose smoke must keep its in-process enforcement flag: without
+    # it the stage only proves the bench ran, not that constrained rows
+    # accepted drafts with zero violations
+    assert '"--assert-spec-structured"' in src

@@ -391,9 +391,11 @@ def test_structured_off_bitwise_identical_and_no_biased_compile():
     _check_constrained("choice", TOK.decode(mixed["s"]))
 
 
-def test_spec_decode_skips_structured_rows_bitwise_parity():
-    """Mixed spec+structured batch: drafting must never touch constrained
-    rows, and the whole batch must match the non-spec engine bitwise."""
+def test_spec_decode_structured_rows_bitwise_parity():
+    """Mixed spec+structured batch: constrained rows now draft through the
+    grammar-masked verify program (spec_structured, on by default), and the
+    whole batch must still match the non-spec engine bitwise. The compose
+    itself is pinned in depth by tests/test_spec_structured.py."""
     vocab = get_model_config("tiny").vocab_size
     echo = [(7919 + j % 3) % (vocab - 2) + 1 for j in range(48)]
     outs = []

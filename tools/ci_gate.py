@@ -103,6 +103,18 @@ def main() -> int:
         stages.append(("bench-tiny-structured",
                        [py, "bench.py", "--tiny", "--cpu",
                         "--workload", "json"], None))
+        # structured x speculative compose smoke (PERF.md Lever 13): the
+        # grammar-masked verify program must land accepted drafts on
+        # constrained rows with ZERO conformance violations on the
+        # constrained-echo workload (--assert-spec-structured enforces both
+        # in-process). batch 2 / spec-tokens 63 is the latency regime the
+        # lever targets: the fused chain spreads its call floor over few
+        # tokens while verify amortizes whole echoed elements per call
+        stages.append(("bench-tiny-spec-structured",
+                       [py, "bench.py", "--tiny", "--cpu", "--batch", "2",
+                        "--spec-mode", "ngram", "--spec-tokens", "63",
+                        "--workload", "json-echo", "--isl", "32",
+                        "--osl", "384", "--assert-spec-structured"], None))
         # warm-start probe round trip on CPU: cold/warm child launches against
         # one persistent compilation cache (the campaign's prog-override point)
         stages.append(("bench-tiny-warmstart",

@@ -33,7 +33,11 @@ HOT_PATHS: dict[str, object] = {
         "_sample_apply",
         "_plan_chain_masks",
         "_stage_chain_masks",
+        "_mask_tables",
         "_constrained_needs_unified",
+        "_unified_eligible",
+        "_run_",           # _run_unified/_run_verify/_run_decode_program
+        "_verify_nt",
         "_pack_buf",
         "_spec_",          # propose/try_verify/release_tail
         "_build_bias",
@@ -45,6 +49,15 @@ HOT_PATHS: dict[str, object] = {
         "_trace_exemplar",
     ],
     "llmd_tpu/engine/spec.py": "*",
+    # step-program registry: the dispatch/complete ledger and routing run
+    # once per engine step. select_decode_attn_impl is startup-only (its
+    # smoke-compile block_until_ready is deliberate) and stays unchecked.
+    "llmd_tpu/engine/programs.py": [
+        "record_dispatch",
+        "record_complete",
+        "route",
+        "quiesced",
+    ],
 }
 
 # Direct device->host synchronization spellings. float()/int()/bool() on

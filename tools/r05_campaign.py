@@ -77,6 +77,17 @@ POINTS: list[tuple] = [
     ("int8-b64-structured-fused-off",
      ["--quantize", "int8", "--batch", "64", "--workload", "json",
       "--structured-fused", "off"]),
+    # structured x speculative compose A/B (PERF.md Lever 13): constrained-
+    # echo workload (fully-forced periodic array serialization) with the
+    # grammar-masked verify program drafting through the constraint, vs the
+    # same workload on the plain fused masked chain. The pair's delta is the
+    # lever's on-chip number; acceptance provenance rides the JSON row
+    # (spec_drafted_constrained / spec_accepted_constrained). Excluded from
+    # best_serving (different workload), like the other echo/json rows.
+    ("int8-b64-spec-json", ["--quantize", "int8", "--batch", "64",
+                            "--spec-mode", "ngram", "--workload", "json-echo"]),
+    ("int8-b64-spec-json-off", ["--quantize", "int8", "--batch", "64",
+                                "--workload", "json-echo"]),
     # Lever 12 pack-overlap A/B at the serving default: off restores the
     # serialized full pack (and its time_host_pack accounting), so the pair's
     # serialized_host_s delta is the lever's measured host-time win on-chip
