@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane perf-regress
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane decisions perf-regress
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -49,6 +49,11 @@ device-obs:
 # global KV plane: precise routing + cross-engine pulls under churn, zero 5xx
 kvplane:
 	JAX_PLATFORMS=cpu $(PY) tools/kv_plane_check.py
+
+# decision plane: per-request routing ledgers, predictor calibration,
+# regret — 100% coverage over a replayed trace, zero 5xx
+decisions:
+	JAX_PLATFORMS=cpu $(PY) tools/decision_check.py
 
 # perf contract: pinned campaign point vs pinned BENCH baseline under
 # per-metric tolerances (tools/perf_regress.py --run gates a fresh bench)

@@ -222,6 +222,19 @@ class LLMEngine:
         from llmd_tpu.obs.attribution import attach_phase_exporter
 
         attach_phase_exporter(self.flight, self.metrics.request_phase)
+        # decision plane, engine view (obs/decisions.py): spec-decode
+        # economics folded per request at retirement. Chained after the
+        # phase exporter (on_finish is a single slot). The knob is cached
+        # so the retire path reads one bool when the ledger is off.
+        from llmd_tpu.obs.decisions import (
+            attach_decision_exporter,
+            decisions_enabled,
+        )
+
+        self._decisions_on = decisions_enabled()
+        if self._decisions_on:
+            attach_decision_exporter(self.flight, self.metrics,
+                                     plane="engine")
         # device-plane monitor (obs/device.py): attached by the owning
         # EngineServer at start(); the dispatch loop stamps its heartbeat
         self.monitor = None
@@ -1927,6 +1940,7 @@ class LLMEngine:
                     probed = True
                 else:
                     s.spec_armed = False
+                    s.spec_flips += 1
         if not probed:
             return False
         self._flush_pending_decode()
@@ -1970,7 +1984,9 @@ class LLMEngine:
             # fresh state proposes nothing: plain decode instead — and no
             # re-probe for these rows until the next landing changes that
             for s, _ in plan:
-                s.spec_armed = False
+                if s.spec_armed:
+                    s.spec_armed = False
+                    s.spec_flips += 1
             return False
         self._step_spec_verify(plan)
         return True
@@ -2103,6 +2119,8 @@ class LLMEngine:
                     stt.state = dev_state
                     stt.n_seen = len(s.token_ids) - s.prompt_len
             s.spec_accepted += accepted
+            if not s.spec_armed:
+                s.spec_flips += 1
             s.spec_armed = True  # fresh tokens landed for this row: re-probe
             st = self.stats
             st.spec_accepted += accepted
@@ -2575,6 +2593,8 @@ class LLMEngine:
             self.stats.total_decode_tokens += len(kept)
             self.stats.decode_tokens_fused += len(kept)
             if kept:
+                if not s.spec_armed:
+                    s.spec_flips += 1
                 s.spec_armed = True  # fresh tokens landed: re-probe this row
             n_tokens += len(kept)
             # one progress event per fused k-step call (per-N decode progress)
@@ -2620,11 +2640,22 @@ class LLMEngine:
             self.metrics.spec_acceptance.labels(
                 constrained="yes" if constrained else "no").observe(
                 seq.spec_accepted / seq.spec_drafted)
+        # decision-ledger attrs ride the terminal event (None-valued attrs
+        # are dropped by the recorder, so untouched levers add nothing)
+        decision_attrs = {}
+        if self._decisions_on:
+            decision_attrs = dict(
+                spec_drafted=seq.spec_drafted or None,
+                spec_accepted=(seq.spec_accepted
+                               if seq.spec_drafted else None),
+                spec_flips=seq.spec_flips or None,
+                cached_tokens=seq.num_cached_prompt or None)
         self.flight.finish(
             seq.request_id, event="retired", reason=reason or "",
             generated=seq.num_generated,
             ttft_ms=round((seq.first_token_time - seq.arrival_time) * 1e3, 3)
-            if seq.first_token_time is not None else None)
+            if seq.first_token_time is not None else None,
+            **decision_attrs)
         if self.kv_connector is not None and seq.block_hashes:
             # K5 save path: dispatch the chunked staging here (cheap, same
             # helper as the P/D export path), drain + hand bytes to the
@@ -2762,6 +2793,8 @@ class LLMEngine:
                 continue  # aborted / preempted while the sample was in flight
             tok = int(sampled[i])
             s.token_ids.append(tok)
+            if not s.spec_armed:
+                s.spec_flips += 1
             s.spec_armed = True  # fresh token landed: re-probe this row's drafter
             if s.structured is not None:
                 fresh = s.structured.sync(s.token_ids, s.prompt_len)

@@ -258,6 +258,10 @@ class Sequence:
     # tokens land for IT (decode/sample/verify). Per-sequence — one
     # non-repetitive stream must not disarm drafting for the whole batch.
     spec_armed: bool = True
+    # Arm/disarm transitions over the sequence lifetime — the decision
+    # ledger's thrash signal (obs/decisions.py): a high flip count means the
+    # probe keeps oscillating between drafting and giving up.
+    spec_flips: int = 0
     # Structured outputs (llmd_tpu/structured): the per-sequence automaton
     # cursor (StructuredState) when the request is grammar-constrained. The
     # cursor derives from token_ids, which preemption preserves, so recompute

@@ -281,5 +281,11 @@ class KVPlane:
             "remote_request_id": req.request_id,
             "num_blocks": n_blocks,
             "block_hashes": keys[:n_blocks],
-            "peer": peer_addr,  # observability only; engines ignore it
+            # observability only; the router pops both before stamping
+            # (engines would ignore them anyway). saved_tokens_est is the
+            # re-prefill the pull avoids: prefix the peer holds beyond what
+            # the chosen target already had — the decision ledger weighs it
+            # against kv_transfer_prefix_pull_seconds actually spent.
+            "peer": peer_addr,
+            "saved_tokens_est": peer_tokens - target_tokens,
         }

@@ -48,6 +48,7 @@ EVENT_CATALOG = (
     "flow_dispatch",
     "flow_reject",
     "routing_decision",
+    "route_decision",
     "kv_pull_stamped",
     "forward",
     "response",
@@ -377,7 +378,8 @@ def debug_list_response(flight: FlightRecorder, query) -> tuple:
 def debug_detail_response(flight: FlightRecorder, request_id: str) -> tuple:
     """``GET /debug/requests/<id>`` body: (http_status, payload). The detail
     view embeds the phase-attribution ledger so "where did the time go" is
-    answerable from the same fetch as "what happened"."""
+    answerable from the same fetch as "what happened", and the decision
+    ledger so "why did we route here, and was it right" comes with it."""
     rec = flight.get(request_id)
     if rec is None:
         return 404, {"error": f"unknown request id {request_id!r}"}
@@ -385,6 +387,14 @@ def debug_detail_response(flight: FlightRecorder, request_id: str) -> tuple:
         from llmd_tpu.obs.attribution import build_ledger
 
         rec["phase_ledger"] = build_ledger(rec)
+    except Exception:
+        pass
+    try:
+        from llmd_tpu.obs.decisions import build_decision
+
+        decision = build_decision(rec)
+        if decision is not None:
+            rec["decision"] = decision
     except Exception:
         pass
     return 200, rec

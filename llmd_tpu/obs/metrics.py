@@ -609,6 +609,24 @@ class EngineMetrics:
             labelnames=("phase", "tenant", "model"),
             buckets=(0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                      10.0, 30.0))
+        # Decision plane, engine view (obs/decisions.py): spec-decode
+        # economics folded per request at retirement. The global
+        # spec_drafted/spec_accepted counters above tally tokens fleet-wide;
+        # these attribute the waste per retired request ledger.
+        self.decision_ledgers = reg.counter(
+            "llmd_tpu:decision_ledgers_total",
+            "Retired requests folded into a decision ledger, by plane "
+            "(router | engine; same family declared on both registries)",
+            labelnames=("plane",))
+        self.decision_spec_wasted = reg.counter(
+            "llmd_tpu:decision_spec_wasted_tokens_total",
+            "Draft positions packed through verify but rejected, summed per "
+            "request at retirement (the speculation lever's wasted compute)")
+        self.decision_spec_flips = reg.counter(
+            "llmd_tpu:decision_spec_flips_total",
+            "Per-sequence drafter arm/disarm transitions summed at "
+            "retirement (a high flip rate means the acceptance controller "
+            "is thrashing)")
 
 
 class EngineServerMetrics:
@@ -758,6 +776,52 @@ class RouterMetrics:
             labelnames=("phase", "tenant", "model"),
             buckets=(0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                      10.0, 30.0))
+        # Decision plane (obs/decisions.py): why routing chose what it chose
+        # and whether the decision paid off, folded at retirement.
+        self.decision_ledgers = reg.counter(
+            "llmd_tpu:decision_ledgers_total",
+            "Retired requests folded into a decision ledger, by plane "
+            "(router | engine; same family declared on both registries)",
+            labelnames=("plane",))
+        self.decision_regret = reg.histogram(
+            "llmd_tpu:decision_regret",
+            "Chosen-endpoint weighted score minus the best alternative's on "
+            "multi-endpoint schedules (<=0; further below zero = the picker "
+            "overrode the score order harder), bucketed by whether the "
+            "request went on to breach an SLO objective",
+            labelnames=("slo_breached",),
+            buckets=(-2.0, -1.0, -0.5, -0.2, -0.1, -0.05, -0.02, -0.005,
+                     0.0, 0.5))
+        self.decision_reschedules = reg.counter(
+            "llmd_tpu:decision_reschedules_total",
+            "Retry/hedge re-schedules observed on retired request ledgers, "
+            "by kind",
+            labelnames=("kind",))
+        self.predictor_calibration_error = reg.histogram(
+            "llmd_tpu:predictor_calibration_error_ms",
+            "Signed latency-predictor calibration error (observed minus "
+            "predicted, ms) joined at retirement, per objective (ttft|e2e) "
+            "and model — a skewed sign means systematic bias, wide spread "
+            "means the predictor is noise",
+            labelnames=("objective", "model"),
+            buckets=(-5000.0, -1000.0, -250.0, -50.0, -10.0, 0.0, 10.0,
+                     50.0, 250.0, 1000.0, 5000.0))
+        self.predictor_calibration_ape = reg.gauge(
+            "llmd_tpu:predictor_calibration_ape",
+            "Rolling mean absolute percentage error of the latency "
+            "predictor over the last LLMD_DECISION_CALIB_WINDOW retired "
+            "requests, per objective and model",
+            labelnames=("objective", "model"))
+        self.decision_kv_pull_blocks = reg.counter(
+            "llmd_tpu:decision_kv_pull_blocks_total",
+            "KV blocks covered by router-stamped cross-engine pulls, summed "
+            "over retired request ledgers")
+        self.decision_kv_tokens_saved = reg.counter(
+            "llmd_tpu:decision_kv_tokens_saved_total",
+            "Estimated re-prefill tokens saved by stamped pulls (plan-time "
+            "estimate: peer prefix beyond the chosen target's), summed over "
+            "retired request ledgers — weigh against "
+            "llmd_tpu:kv_transfer_prefix_pull_seconds actually spent")
         # Per-tenant accounting (x-llm-d-tenant, default "anon"): the
         # fairness foundation — token spend and request volume by tenant.
         self.tenant_requests = reg.counter(

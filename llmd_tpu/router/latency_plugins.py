@@ -41,6 +41,15 @@ def slo_headroom_ms(req: InferenceRequest, pred: tuple[float, float]) -> Optiona
     return min(hs) if hs else None
 
 
+def predicted_e2e_ms(req: InferenceRequest, pred: tuple[float, float]) -> float:
+    """E2E estimate from a (ttft_ms, tpot_ms) prediction — the same
+    max-tokens extrapolation LatencyScorer ranks by in the no-SLO case. The
+    decision ledger (obs/decisions.py) stamps this on ``route_decision`` so
+    calibration error can be joined against the observed wall clock."""
+    ttft, tpot = pred
+    return float(ttft) + float(tpot) * req.sampling.max_tokens
+
+
 @register_plugin("predicted-latency-producer")
 class PredictedLatencyProducer(DataProducer):
     """Predict TTFT/TPOT per candidate; feed observed latencies back as training.

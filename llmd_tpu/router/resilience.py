@@ -329,6 +329,31 @@ class ResilienceManager:
             return [a for a, br in self._breakers.items()
                     if br.state is BreakerState.OPEN and now < br.open_until]
 
+    def attempt_states(self, addresses: Iterable[str]) -> dict[str, dict]:
+        """Per-address breaker view for the routing decision ledger
+        (obs/decisions.py): only non-pristine entries are reported, so the
+        ledger records WHY resilience dropped candidates without bloating
+        the common all-healthy case."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            for a in addresses:
+                br = self._breakers.get(a)
+                draining = a in self._draining
+                if br is None and not draining:
+                    continue
+                if br is not None and br.state is BreakerState.CLOSED \
+                        and not br.consecutive_failures and not draining:
+                    continue
+                entry: dict = {}
+                if br is not None:
+                    entry["state"] = br.state.value
+                    if br.consecutive_failures:
+                        entry["consecutive_failures"] = br.consecutive_failures
+                if draining:
+                    entry["draining"] = True
+                out[a] = entry
+        return out
+
     def snapshot(self) -> dict:
         """Breaker/drain state for /health and debugging."""
         with self._lock:

@@ -23,7 +23,12 @@ from llmd_tpu.router.plugins import (
     Scorer,
     build_plugin,
 )
-from llmd_tpu.router.scorers import STATE_PREFIX_HITS, STATE_TOKEN_IDS
+from llmd_tpu.obs.decisions import decisions_enabled
+from llmd_tpu.router.scorers import (
+    STATE_PREFIX_HITS,
+    STATE_TOKEN_IDS,
+    clamp_scores,
+)
 
 
 @dataclass
@@ -31,6 +36,11 @@ class ProfileRun:
     name: str
     endpoint: Optional[Endpoint]
     scores: dict[Endpoint, float] = field(default_factory=dict)
+    # Decision-ledger capture (obs/decisions.py): {"filters": [[name, dropped]],
+    # "candidates": n, "tie": n, "scorers": [(name, weight, {Endpoint: score})]}.
+    # None whenever LLMD_DECISION_LEDGER is off — the detail path then
+    # allocates nothing.
+    detail: Optional[dict] = None
 
 
 @dataclass
@@ -42,6 +52,9 @@ class SchedulingResult:
     profiles: dict[str, ProfileRun] = field(default_factory=dict)
     rejected: Optional[str] = None
     latency_s: float = 0.0
+    # Candidates removed before any profile ran ({"excluded": n,
+    # "resilience_dropped": n}); None when the decision ledger is off.
+    pre_drops: Optional[dict] = None
 
 
 class Profile:
@@ -58,19 +71,38 @@ class Profile:
             elif hasattr(plugin, "pick"):
                 self.picker = plugin
 
-    def run(self, req: InferenceRequest, endpoints: list[Endpoint]) -> ProfileRun:
+    def run(self, req: InferenceRequest, endpoints: list[Endpoint],
+            detail: bool = False) -> ProfileRun:
         cands = list(endpoints)
+        drops: Optional[list] = [] if detail else None
         for f in self.filters:
-            cands = f.filter(req, cands)
+            kept = f.filter(req, cands)
+            if detail and len(kept) != len(cands):
+                drops.append([type(f).__name__, len(cands) - len(kept)])
+            cands = kept
             if not cands:
-                return ProfileRun(self.name, None)
+                det = ({"filters": drops, "candidates": 0, "tie": 0,
+                        "scorers": []} if detail else None)
+                return ProfileRun(self.name, None, detail=det)
         totals: dict[Endpoint, float] = {e: 0.0 for e in cands}
+        per_scorer: Optional[list] = [] if detail else None
         for scorer, weight in self.scorers:
-            for e, s in scorer.score(req, cands).items():
-                if e in totals:
-                    totals[e] += weight * s
+            scores = clamp_scores(scorer.score(req, cands), totals)
+            for e, s in scores.items():
+                totals[e] += weight * s
+            if detail:
+                per_scorer.append((type(scorer).__name__, weight, scores))
         picked = self.picker.pick(req, totals) if self.picker else None
-        return ProfileRun(self.name, picked, totals)
+        det = None
+        if detail:
+            mx = max(totals.values()) if totals else 0.0
+            det = {
+                "filters": drops,
+                "candidates": len(totals),
+                "tie": sum(1 for s in totals.values() if s >= mx - 1e-9),
+                "scorers": per_scorer,
+            }
+        return ProfileRun(self.name, picked, totals, det)
 
 
 class Scheduler:
@@ -102,6 +134,9 @@ class Scheduler:
         # Resilience hook (router/resilience.py): filters breaker-open and
         # draining endpoints out of every pick. None = no filtering.
         self.endpoint_filter: Optional[Callable[[list[Endpoint]], list[Endpoint]]] = None
+        # Decision-ledger switch, read once: when off, Profile.run skips all
+        # detail capture and schedule() allocates nothing extra per request.
+        self.record_decisions = decisions_enabled()
 
     # ------------------------------------------------------------------
     def schedule(self, req: InferenceRequest,
@@ -112,12 +147,21 @@ class Scheduler:
         fail-open backstop cannot hand back an endpoint that just failed."""
         t0 = time.monotonic()
         endpoints = self.pool.list()
+        n_pool = len(endpoints)
         if exclude:
             endpoints = [e for e in endpoints if e.address not in exclude]
+        n_after_exclude = len(endpoints)
         if self.endpoint_filter is not None and endpoints:
             endpoints = self.endpoint_filter(endpoints)
         if not endpoints:
             return SchedulingResult(None, rejected="no endpoints")
+        pre_drops = None
+        if self.record_decisions:
+            n_excluded = n_pool - n_after_exclude
+            n_resilience = n_after_exclude - len(endpoints)
+            if n_excluded or n_resilience:
+                pre_drops = {"excluded": n_excluded,
+                             "resilience_dropped": n_resilience}
         for p in self.producers:
             p.produce(req, endpoints)
         for a in self.admitters:
@@ -130,6 +174,7 @@ class Scheduler:
             res = self._schedule_disagg(req, endpoints)
         else:
             res = self._schedule_single(req, endpoints)
+        res.pre_drops = pre_drops
 
         if res.endpoint is not None:
             self.metrics["scheduled_total"] += 1
@@ -156,7 +201,7 @@ class Scheduler:
         prof = self._profile("default") or next(iter(self.profiles.values()), None)
         if prof is None:
             return SchedulingResult(None, rejected="no scheduling profile")
-        run = prof.run(req, endpoints)
+        run = prof.run(req, endpoints, detail=self.record_decisions)
         return SchedulingResult(run.endpoint, profiles={prof.name: run},
                                 rejected=None if run.endpoint else "no endpoint passed filters")
 
@@ -170,7 +215,7 @@ class Scheduler:
         dec_prof = self._profile("decode") or self._profile("default")
         if dec_prof is None:
             return SchedulingResult(None, rejected="no decode profile")
-        dec = dec_prof.run(req, endpoints)
+        dec = dec_prof.run(req, endpoints, detail=self.record_decisions)
         if dec.endpoint is None:
             return SchedulingResult(None, rejected="no decode endpoint")
         result = SchedulingResult(dec.endpoint, profiles={dec_prof.name: dec})
@@ -183,7 +228,8 @@ class Scheduler:
         uncached = n_tokens - hits.get(dec.endpoint.address, 0)
         if uncached < self.pd_threshold_tokens:
             return result  # short uncached suffix: decode-only (aggregated)
-        pre = pre_prof.run(req, [e for e in endpoints if e != dec.endpoint] or endpoints)
+        pre = pre_prof.run(req, [e for e in endpoints if e != dec.endpoint] or endpoints,
+                           detail=self.record_decisions)
         if pre.endpoint is not None:
             result.prefill_endpoint = pre.endpoint
             result.profiles[pre_prof.name] = pre

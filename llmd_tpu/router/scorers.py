@@ -34,6 +34,27 @@ def _normalize_inverse(values: dict[Endpoint, float]) -> dict[Endpoint, float]:
     return {e: 1.0 - v / mx for e, v in values.items()}
 
 
+def clamp_scores(scores: dict[Endpoint, float],
+                 within: dict[Endpoint, Any]) -> dict[Endpoint, float]:
+    """Clamp a scorer result to the post-filter candidate set ``within``.
+
+    Scorers are handed the surviving candidates, but one working off cached
+    state (a stale snapshot taken before a filter pass) can hand back scores
+    for endpoints that were filtered out. Entries outside the candidate set
+    are dropped, and if the dropped entry held the normalization max the
+    survivors are rescaled so the best of them is 1.0 again — otherwise a
+    stale scorer's effective weight silently shrinks relative to its peers
+    in the weighted sum. Well-behaved scorers pass through untouched."""
+    if all(e in within for e in scores):
+        return scores
+    kept = {e: s for e, s in scores.items() if e in within}
+    mx = max(kept.values(), default=0.0)
+    if 0.0 < mx < 1.0:
+        inv = 1.0 / mx
+        kept = {e: s * inv for e, s in kept.items()}
+    return kept
+
+
 @register_plugin("queue-depth-scorer")
 class QueueDepthScorer:
     def score(self, req: InferenceRequest, endpoints: list[Endpoint]) -> dict[Endpoint, float]:

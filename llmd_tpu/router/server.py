@@ -42,7 +42,7 @@ from llmd_tpu.router.resilience import (
     ResilienceManager,
 )
 from llmd_tpu.router.scheduler import Scheduler
-from llmd_tpu.router.scorers import STATE_TOKEN_IDS
+from llmd_tpu.router.scorers import STATE_PREDICTED, STATE_TOKEN_IDS
 
 GEN_PATHS = ("/v1/completions", "/v1/chat/completions", "/v1/embeddings",
              "/v1/responses")
@@ -296,6 +296,15 @@ class RouterServer:
         from llmd_tpu.obs.attribution import attach_phase_exporter
 
         attach_phase_exporter(self.flight, self.metrics.request_phase)
+        # Decision plane (obs/decisions.py): chained AFTER the phase
+        # exporter (on_finish is a single slot — the decision hook wraps
+        # and forwards). When the ledger is off nothing is attached and
+        # the scheduler records no detail: the off path costs nothing.
+        from llmd_tpu.obs.decisions import attach_decision_exporter
+
+        if self.scheduler.record_decisions:
+            attach_decision_exporter(self.flight, self.metrics,
+                                     plane="router")
         # Fleet rollup plane (obs/fleet.py): rides the poller's extractor
         # chain; one router scrape then answers fleet tok/s, HBM headroom,
         # KV residency, fabric/stall counts without touching any replica.
@@ -439,6 +448,96 @@ class RouterServer:
                 out[name] = {ep.address: round(s, 4)
                              for ep, s in scores.items()}
         return out or None
+
+    def _decision_payload(self, req: InferenceRequest, result) -> Optional[dict]:
+        """Flatten a SchedulingResult's decision detail into the
+        ``route_decision`` event payload (obs/decisions.py): per-profile
+        filter eliminations, top-k ranked candidates, weighted per-scorer
+        breakdown for the chosen endpoint and runner-up, tie width, regret,
+        plus the predictor's stamps for the calibration join at retire."""
+        from llmd_tpu.obs.decisions import regret_topk
+        from llmd_tpu.router.latency_plugins import predicted_e2e_ms
+
+        topk = regret_topk()
+        profs: dict = {}
+        primary_regret = None
+        for name, run in (result.profiles or {}).items():
+            det = getattr(run, "detail", None)
+            if det is None:
+                continue
+            scores = run.scores or {}
+            ranked = sorted(scores.items(),
+                            key=lambda kv: (-kv[1], kv[0].address))
+            entry: dict = {
+                "candidates": det["candidates"],
+                "tie": det["tie"],
+                "top": [[ep.address, round(s, 4)] for ep, s in ranked[:topk]],
+            }
+            if det["filters"]:
+                entry["filters"] = det["filters"]
+            chosen = run.endpoint.address if run.endpoint is not None else None
+            if chosen is not None:
+                entry["chosen"] = chosen
+                runner = next((ep.address for ep, _ in ranked
+                               if ep.address != chosen), None)
+                breakdown: dict = {}
+                for sname, weight, smap in det["scorers"]:
+                    for ep, s in smap.items():
+                        if ep.address in (chosen, runner):
+                            breakdown.setdefault(ep.address, {})[sname] = \
+                                round(weight * s, 4)
+                if breakdown:
+                    entry["breakdown"] = breakdown
+                if runner is not None:
+                    chosen_score = next(
+                        (s for ep, s in ranked if ep.address == chosen), 0.0)
+                    best_alt = max(
+                        (s for ep, s in scores.items()
+                         if ep.address != chosen), default=None)
+                    if best_alt is not None:
+                        entry["regret"] = round(chosen_score - best_alt, 4)
+                        if (result.endpoint is not None
+                                and chosen == result.endpoint.address):
+                            primary_regret = entry["regret"]
+            profs[name] = entry
+        if not profs:
+            return None
+        payload: dict = {"profiles": profs}
+        if primary_regret is not None:
+            payload["regret"] = primary_regret
+        if result.pre_drops:
+            payload.update(result.pre_drops)
+            if result.pre_drops.get("resilience_dropped"):
+                breakers = self.resilience.attempt_states(
+                    e.address for e in self.pool.list())
+                if breakers:
+                    payload["breakers"] = breakers
+        from llmd_tpu.kvplane import STATE_KV_PLANE
+
+        kv_path = req.state.get(STATE_KV_PLANE)
+        if kv_path:
+            payload["kv_plane"] = kv_path  # "precise" | degraded-path reason
+        if result.endpoint is not None:
+            pred = (req.state.get(STATE_PREDICTED) or {}).get(
+                result.endpoint.address)
+            if pred is not None:
+                payload["predicted_ttft_ms"] = round(float(pred[0]), 3)
+                payload["predicted_e2e_ms"] = round(
+                    predicted_e2e_ms(req, pred), 3)
+        return payload
+
+    def _record_route_decision(self, req: InferenceRequest, result,
+                               attempt: Optional[int] = None) -> None:
+        """Emit the decision ledger's ``route_decision`` event. Gated on the
+        scheduler's cached knob so the off path never builds the payload."""
+        if not self.scheduler.record_decisions:
+            return
+        payload = self._decision_payload(req, result)
+        if payload is None:
+            return
+        if attempt is not None:
+            payload["attempt"] = attempt
+        self.flight.record(req.request_id, "route_decision", **payload)
 
     def _observe_e2e(self, seconds: float, exemplar=None) -> None:
         # promql.md alert HighP99Latency reads these buckets; the exemplar
@@ -704,11 +803,13 @@ class RouterServer:
         if plan is None:
             return
         peer = plan.pop("peer", None)
+        saved = plan.pop("saved_tokens_est", None)
         body["kv_transfer_params"] = plan
         req.state["kv_plane_stamped"] = True
         self.flight.record(req.request_id, "kv_pull_stamped",
                            endpoint=target.address, peer=peer,
-                           blocks=len(plan.get("block_hashes") or ()))
+                           blocks=len(plan.get("block_hashes") or ()),
+                           saved_tokens_est=saved)
 
     async def _handle_generate(self, request: web.Request):
         t_start = time.monotonic()
@@ -830,6 +931,7 @@ class RouterServer:
                               if result.prefill_endpoint else None),
             latency_ms=round(result.latency_s * 1e3, 3),
             scores=self._profile_scores(result))
+        self._record_route_decision(req, result)
         self.flight.record(req.request_id, "forward",
                            endpoint=result.endpoint.address)
 
@@ -928,6 +1030,7 @@ class RouterServer:
             self.flight.record(req.request_id, "routing_decision",
                                endpoint=target.address, retry_attempt=attempt,
                                scores=self._profile_scores(repick))
+            self._record_route_decision(req, repick, attempt=attempt)
             self.flight.record(req.request_id, "forward",
                                endpoint=target.address, attempt=attempt)
 

@@ -102,6 +102,12 @@ async def main_async() -> int:
                         statuses[-1] = statuses.get(-1, 0) + 1
 
             await asyncio.gather(*(one(i) for i in range(N_REQUESTS)))
+        # decision-ledger coverage (ISSUE 16): retried/hedged chaos traffic
+        # must still carry a complete routing ledger on every retirement
+        from tools.slo_check import decision_ledger_coverage
+
+        n_finished, n_ledgered = await decision_ledger_coverage(
+            router.address)
         snapshot = router.resilience.snapshot()
         retries = {",".join(k): c.value
                    for k, c in router.metrics.retries._children.items()}
@@ -116,11 +122,14 @@ async def main_async() -> int:
                      if code >= 500 or code == -1)
     goodput = good / N_REQUESTS
     injected = {f"server{i}": s.fault_counts for i, s in enumerate(servers)}
-    verdict = goodput >= GOODPUT_FLOOR and server_5xx == 0
+    ledgers_ok = n_finished > 0 and n_ledgered == n_finished
+    verdict = goodput >= GOODPUT_FLOOR and server_5xx == 0 and ledgers_ok
     print(json.dumps({
         "chaos_check": "ok" if verdict else "failed",
         "requests": N_REQUESTS,
         "goodput": round(goodput, 4),
+        "decision_ledgers": {"finished": n_finished,
+                             "with_ledger": n_ledgered},
         "statuses": {str(k): v for k, v in sorted(statuses.items())},
         "injected_faults": injected,
         "breakers": snapshot["breakers"],
@@ -130,7 +139,8 @@ async def main_async() -> int:
     if not verdict:
         print(f"chaos_check: FAILED — goodput {goodput:.4f} "
               f"(floor {GOODPUT_FLOOR}), client-visible 5xx/errors: "
-              f"{server_5xx}", file=sys.stderr)
+              f"{server_5xx}, decision ledgers {n_ledgered}/{n_finished}",
+              file=sys.stderr)
         return 1
     return 0
 

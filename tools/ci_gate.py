@@ -72,6 +72,10 @@ def main() -> int:
         # global KV plane: precise routing >= 90% prefix-served, cross-engine
         # pull exercised, engine killed mid-run with zero 5xx, index bounded
         ("kv-plane-check", [py, "tools/kv_plane_check.py"], CPU_ENV),
+        # decision plane: 100% of retired requests carry a routing/calibration
+        # ledger, regret + calibration families exported, zero 5xx, and the
+        # ledger stays inside the router-overhead bound
+        ("decision-check", [py, "tools/decision_check.py"], CPU_ENV),
         # perf contract: the pinned campaign point must agree with the pinned
         # BENCH baseline under per-metric tolerances — catches accidental edits
         # to either artifact and keeps the comparator itself exercised

@@ -20,9 +20,14 @@ Usage:
   # where did the time go: phase-attribution ledger per request
   python tools/dump_flight.py http://localhost:8000 --id 1a2b3c... --phases
 
+  # why did we route here, and was it right: decision ledger per request
+  python tools/dump_flight.py http://localhost:8000 --id 1a2b3c... --decisions
+
   # correlate a trace with its flight timeline(s): every request that
-  # carried this W3C trace id, rendered as full timelines
-  python tools/dump_flight.py http://localhost:8000 --trace 4bf92f35...
+  # carried this W3C trace id, rendered as full timelines. Render flags
+  # compose: one invocation can select by trace AND append both ledgers
+  python tools/dump_flight.py http://localhost:8000 --trace 4bf92f35... \
+      --phases --decisions
 
   # snapshot to a file, render offline later
   python tools/dump_flight.py http://localhost:8000 --save flight.json
@@ -101,7 +106,8 @@ def _fmt_attrs(ev: dict) -> str:
                     if k not in ("event", "t_ms", "t_unix"))
 
 
-def render_timeline(rec: dict, out=sys.stdout, phases: bool = False) -> None:
+def render_timeline(rec: dict, out=sys.stdout, phases: bool = False,
+                    decisions: bool = False) -> None:
     print(f"request {rec.get('request_id')}  model={rec.get('model') or '-'}  "
           f"tenant={rec.get('tenant') or '-'}  "
           f"status={rec.get('status')}  latency={rec.get('latency_ms')}ms  "
@@ -116,6 +122,8 @@ def render_timeline(rec: dict, out=sys.stdout, phases: bool = False) -> None:
               file=out)
     if phases:
         render_phases(rec, out=out)
+    if decisions:
+        render_decisions(rec, out=out)
 
 
 def render_phases(rec: dict, out=sys.stdout) -> None:
@@ -138,6 +146,76 @@ def render_phases(rec: dict, out=sys.stdout) -> None:
     for phase, ms in rows:
         pct = 100.0 * ms / total if total > 0 else 0.0
         print(f"    {phase:<26} {ms:>12.3f}ms  {pct:>5.1f}%", file=out)
+
+
+def render_decisions(rec: dict, out=sys.stdout) -> None:
+    """Decision-ledger table (obs/decisions.py): why routing picked this
+    endpoint, whether the predictor was calibrated, and whether the KV/spec
+    levers paid. Computed locally from events when the server didn't embed
+    one, so offline dumps and older servers both render."""
+    from llmd_tpu.obs.decisions import build_decision
+
+    if not rec.get("events"):
+        print("  (no events: decision ledger unavailable — summaries carry "
+              "no timeline; use --id or --trace for detail records)", file=out)
+        return
+    ledger = rec.get("decision") or build_decision(rec)
+    if ledger is None:
+        print("  (no decision ledger: recorded with LLMD_DECISION_LEDGER "
+              "off, or nothing decision-relevant happened)", file=out)
+        return
+    if ledger["plane"] == "router":
+        resched = ledger.get("reschedules") or {}
+        print(f"  decision ledger (router plane): "
+              f"schedules={ledger.get('schedules')} "
+              f"retries={resched.get('retry', 0)} "
+              f"hedges={resched.get('hedge', 0)} "
+              f"regret={ledger.get('regret', '-')} "
+              f"slo_breached={ledger.get('slo_breached')}", file=out)
+        for key in ("excluded", "resilience_dropped", "kv_plane"):
+            if ledger.get(key):
+                print(f"    {key}: {ledger[key]}", file=out)
+        for name, prof in (ledger.get("profiles") or {}).items():
+            print(f"    profile {name}: candidates={prof.get('candidates')} "
+                  f"tie={prof.get('tie')} chosen={prof.get('chosen', '-')} "
+                  f"regret={prof.get('regret', '-')}", file=out)
+            for fname, dropped in prof.get("filters") or []:
+                print(f"      filter {fname}: dropped {dropped}", file=out)
+            for addr, score in prof.get("top") or []:
+                parts = (prof.get("breakdown") or {}).get(addr)
+                detail = (" (" + ", ".join(f"{k}={v}"
+                                           for k, v in parts.items()) + ")"
+                          if parts else "")
+                print(f"      {addr:<24} {score:>8.4f}{detail}", file=out)
+        calib = ledger.get("calibration")
+        if calib:
+            print("    predictor calibration:", file=out)
+            for obj in ("ttft", "e2e"):
+                if f"{obj}_error_ms" in calib:
+                    print(f"      {obj}: predicted="
+                          f"{calib.get(f'{obj}_predicted_ms')}ms observed="
+                          f"{calib.get(f'{obj}_observed_ms')}ms error="
+                          f"{calib[f'{obj}_error_ms']:+}ms", file=out)
+        kv = ledger.get("kv")
+        if kv:
+            print(f"    kv lever: stamped={kv.get('stamped')} "
+                  f"blocks={kv.get('blocks')} "
+                  f"saved_tokens_est={kv.get('saved_tokens_est')}", file=out)
+    else:
+        print("  decision ledger (engine plane):", file=out)
+        spec = ledger.get("spec")
+        if spec:
+            print(f"    spec lever: drafted={spec.get('drafted')} "
+                  f"accepted={spec.get('accepted')} "
+                  f"wasted={spec.get('wasted')} flips={spec.get('flips')}",
+                  file=out)
+        kv = ledger.get("kv")
+        if kv:
+            print(f"    kv lever: outcome={kv.get('outcome')} "
+                  f"blocks={kv.get('blocks')} pull_ms={kv.get('ms')}",
+                  file=out)
+        if ledger.get("cached_tokens"):
+            print(f"    cached_tokens: {ledger['cached_tokens']}", file=out)
 
 
 def render_list(payload: dict, out=sys.stdout) -> None:
@@ -178,6 +256,11 @@ def main(argv=None) -> int:
                     help="append the phase-attribution ledger (where the "
                          "wall clock went, residual included) to each "
                          "rendered timeline")
+    ap.add_argument("--decisions", action="store_true",
+                    help="append the decision ledger (why routing chose "
+                         "this endpoint, predictor calibration, KV/spec "
+                         "lever economics) to each rendered timeline; "
+                         "composes with --phases and --trace")
     ap.add_argument("--limit", type=int, default=100)
     ap.add_argument("--timeout", type=float, default=10.0)
     ap.add_argument("--save", metavar="PATH",
@@ -195,33 +278,40 @@ def main(argv=None) -> int:
             json.dump(payload, f, indent=2)
         print(f"wrote {args.save}")
         return 0
-    if args.id:
-        recs = [r for r in payload["requests"]
-                if r.get("request_id") == args.id] or payload["requests"][:1]
-        if not recs:
-            print(f"error: request {args.id!r} not found", file=sys.stderr)
-            return 1
-        render_timeline(recs[0], phases=args.phases)
-    elif args.trace:
-        # offline dumps filter here; live payloads arrive pre-filtered (and
-        # already carry full timelines) — the filter is then a no-op
-        recs = [r for r in payload["requests"]
-                if r.get("trace_id") == args.trace]
-        if not recs:
-            print(f"error: no request carries trace {args.trace!r}",
-                  file=sys.stderr)
-            return 1
-        print(f"trace {args.trace}: {len(recs)} request(s)")
+    recs, err = select_records(payload, args)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    if args.id or args.trace or args.phases or args.decisions:
+        # timeline mode: one shared selection, composable render flags —
+        # --phases and --decisions each append their ledger per record
+        if args.trace:
+            print(f"trace {args.trace}: {len(recs)} request(s)")
         for rec in recs:
-            render_timeline(rec, phases=args.phases)
-    elif args.phases:
-        # list mode with --phases: render every record that carries events
-        # (offline full dumps do; live summaries print the hint instead)
-        for rec in payload["requests"]:
-            render_timeline(rec, phases=True)
+            render_timeline(rec, phases=args.phases,
+                            decisions=args.decisions)
     else:
         render_list(payload)
     return 0
+
+
+def select_records(payload: dict, args: argparse.Namespace):
+    """Shared record-selection path for every render mode: ``--id`` picks
+    one record, ``--trace`` filters by trace id (offline dumps filter here;
+    live payloads arrive pre-filtered and already carry full timelines),
+    otherwise every record. Returns (records, error)."""
+    rows = payload.get("requests", [])
+    if args.id:
+        recs = [r for r in rows if r.get("request_id") == args.id] or rows[:1]
+        if not recs:
+            return [], f"request {args.id!r} not found"
+        return recs[:1], None
+    if args.trace:
+        recs = [r for r in rows if r.get("trace_id") == args.trace]
+        if not recs:
+            return [], f"no request carries trace {args.trace!r}"
+        return recs, None
+    return rows, None
 
 
 if __name__ == "__main__":

@@ -45,6 +45,31 @@ os.environ.setdefault("LLMD_BREAKER_COOLDOWN_S", "0.5")
 SLO_E2E_S = 2.5
 ATTAINMENT_FLOOR = 0.95
 
+
+async def decision_ledger_coverage(base: str) -> tuple[int, int]:
+    """(finished, with_decision_ledger) over the router's flight ring —
+    ISSUE 16 acceptance: with the ledger on (default), 100% of retired
+    requests must carry a ``decision`` in ``/debug/requests/<id>``."""
+    import aiohttp
+
+    timeout = aiohttp.ClientTimeout(total=10)
+    finished = with_ledger = 0
+    async with aiohttp.ClientSession() as sess:
+        async with sess.get(f"http://{base}/debug/requests"
+                            f"?status=finished&limit=500",
+                            timeout=timeout) as r:
+            rows = (await r.json()).get("requests", [])
+        for row in rows:
+            rid = row.get("request_id", "")
+            async with sess.get(f"http://{base}/debug/requests/{rid}",
+                                timeout=timeout) as r:
+                detail = await r.json()
+            finished += 1
+            d = detail.get("decision")
+            if d and d.get("profiles"):
+                with_ledger += 1
+    return finished, with_ledger
+
 CFG = """
 flowControl:
   enabled: true
@@ -146,6 +171,8 @@ async def main_async(full: bool) -> int:
         report = await replay_trace(router.address, trace,
                                     slo_e2e_s=SLO_E2E_S)
         await chaos_task
+        n_finished, n_ledgered = await decision_ledger_coverage(
+            router.address)
         # distinct launched addresses is the high-water mark: churned replicas
         # (killed + replaced) still prove the pool scaled past the floor
         peak_replicas = max(len(controller.replicas),
@@ -193,8 +220,9 @@ async def main_async(full: bool) -> int:
         warm_beats_cold = (warm_launch_s is not None
                            and warm_launch_s < launcher.engine_build_s
                            and warm_0_to_1_s < cold_0_to_1_s)
+        ledgers_ok = n_finished > 0 and n_ledgered == n_finished
         ok = (attainment_ok and zero_5xx and scaled_up and at_floor
-              and wake_status == 200 and warm_beats_cold)
+              and wake_status == 200 and warm_beats_cold and ledgers_ok)
         verdict = {
             "slo_check": "ok" if ok else "failed",
             "trace": {"duration_s": duration_s, "base_rps": base_rps,
@@ -215,10 +243,13 @@ async def main_async(full: bool) -> int:
             "wake_status": wake_status,
             "launches": controller.status()["launches"],
             "pool_events": len(scale_events),
+            "decision_ledgers": {"finished": n_finished,
+                                 "with_ledger": n_ledgered},
             "checks": {
                 "attainment": attainment_ok, "zero_5xx": zero_5xx,
                 "scaled_up": scaled_up, "returned_to_floor": at_floor,
                 "warm_beats_cold": warm_beats_cold,
+                "decision_ledgers": ledgers_ok,
             },
         }
     finally:
