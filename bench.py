@@ -42,12 +42,17 @@ import time
 B200_ANCHOR_TOK_S = 3100.0
 
 
-def _param_count(cfg) -> int:
-    D, L, F = cfg.hidden_size, cfg.num_layers, cfg.intermediate_size
-    H, Hk, Dh = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-    per_layer = D * (H + 2 * Hk) * Dh + H * Dh * D + 3 * D * F  # qkvo + swiglu
-    emb = cfg.vocab_size * D * (1 if cfg.tie_embeddings else 2)
-    return per_layer * L + emb
+# Roofline math is shared with the live utilization plane (PR 17): one
+# source of truth in obs/costmodel.py for params, per-token FLOPs/bytes and
+# the device-generation peak table, so the offline decode_mfu here and the
+# live llmd_tpu:program_mfu gauge can never drift apart.
+from llmd_tpu.obs.costmodel import (  # noqa: E402
+    GOODPUT_KINDS,
+    bytes_per_param as _bytes_per_param,
+    chip_peaks as _shared_chip_peaks,
+    flops_per_token as _flops_per_token,
+    param_count as _param_count,
+)
 
 
 def _device_preflight(attempts: int = 2, wait_s: float = 20.0,
@@ -92,18 +97,10 @@ def _device_preflight(attempts: int = 2, wait_s: float = 20.0,
 
 
 def _chip_peaks(device_kind: str) -> tuple[float, float]:
-    """(bf16 TFLOP/s, HBM GB/s) for MFU / bandwidth-utilization estimates."""
-    kinds = {
-        "TPU v5 lite": (197.0, 819.0),
-        "TPU v5e": (197.0, 819.0),
-        "TPU v5p": (459.0, 2765.0),
-        "TPU v4": (275.0, 1228.0),
-        "TPU v6e": (918.0, 1640.0),
-    }
-    for k, v in kinds.items():
-        if k.lower() in device_kind.lower():
-            return v
-    return (197.0, 819.0)  # default to v5e-class
+    """(bf16 TFLOP/s, HBM GB/s) from the shared costmodel peak table; bench
+    keeps its historical off-table default (v5e-class) so CPU/unknown runs
+    still print a roofline context instead of nulls."""
+    return _shared_chip_peaks(device_kind, default=(197.0, 819.0))
 
 
 def main() -> None:
@@ -467,6 +464,12 @@ def main() -> None:
                                 moe_backend=eng.stats.moe_backend,
                                 kv_cache_dtype=eng.stats.kv_cache_dtype,
                                 kv_layout=eng.stats.kv_layout)
+        # utilization-ledger baseline: registry counters can't reset, so the
+        # goodput/recompile provenance keys report measured-window DELTAS
+        # against this post-warmup snapshot (matching the stats reset above)
+        eng.util_bench_base = (
+            (eng.util.totals(), eng.util.compiles())
+            if eng.util is not None else None)
         t0 = time.monotonic()
         out = eng.generate(prompts(n_req, salt=2, tok=tok), sp)
         return eng, out, time.monotonic() - t0
@@ -701,8 +704,7 @@ def main() -> None:
     # int8 weight-only serves ~1 byte/param for the dense per-step stream
     # (scales are per-channel, negligible); the weights-BW estimate must use
     # the bytes actually read or utilization overstates 2x
-    bytes_per_param = (1 if eng_cfg.quantize_weights == "int8"
-                       else 2 if cfg.dtype == "bfloat16" else 4)
+    bytes_per_param = _bytes_per_param(cfg, eng_cfg.quantize_weights)
     peak_tflops, peak_gbs = _chip_peaks(getattr(dev, "device_kind", ""))
     # decode reads all weights once per step for max_batch_size tokens
     model_gb = n_params * bytes_per_param / 1e9
@@ -716,13 +718,30 @@ def main() -> None:
     # tokens whose wall time lands in time_prefill_steps.
     decode_tput = st.decode_tokens_fused / max(1e-9, st.time_decode_steps)
     decode_bw_gbs = decode_tput * hbm_gb_per_tok
-    flops_per_tok = 2 * n_params
+    flops_per_tok = _flops_per_token(cfg)
     mfu = tput * flops_per_tok / (peak_tflops * 1e12)
     launch_gap = (wall - st.time_prefill_steps - st.time_decode_steps
                   - st.time_spec_steps)
     dev_ms_per_decode = (st.time_device_decode / max(1, st.n_decode_calls)) * 1e3
     pack_us_per_call = (
         st.time_host_pack / max(1, st.n_decode_calls + st.n_unified_steps)) * 1e6
+    # token-goodput + recompile provenance over the measured window (deltas
+    # against the post-warmup ledger snapshot; None with LLMD_UTIL_LEDGER off)
+    goodput = {k: None for k in GOODPUT_KINDS}
+    padding_efficiency = recompiles = None
+    if eng.util is not None and getattr(eng, "util_bench_base", None) is not None:
+        base_tokens, base_compiles = eng.util_bench_base
+        goodput = {k: 0 for k in GOODPUT_KINDS}
+        for prog_name, tk in eng.util.totals().items():
+            base = base_tokens.get(prog_name, {})
+            for kind, v in tk.items():
+                goodput[kind] += v - base.get(kind, 0)
+        real = (goodput["committed"] + goodput["spec_rejected"]
+                + goodput["preempted_recompute"])
+        cap = real + goodput["padding"]
+        padding_efficiency = round(real / cap, 4) if cap else None
+        recompiles = sum(v - base_compiles.get(p, 0)
+                         for p, v in eng.util.compiles().items())
 
     print(f"# {out_tokens} output tokens in {wall:.2f}s "
           f"(prefill {st.total_prefill_tokens} toks, "
@@ -781,6 +800,15 @@ def main() -> None:
         "prefill_tokens": st.total_prefill_tokens,
         "decode_tokens": st.total_decode_tokens,
         "preemptions": st.total_preemptions,
+        # utilization plane (obs/costmodel.py): slot-token fate over the
+        # measured window — counters exact run-to-run for a fixed workload
+        "goodput_committed_tokens": goodput["committed"],
+        "goodput_spec_rejected_tokens": goodput["spec_rejected"],
+        "goodput_padding_tokens": goodput["padding"],
+        "goodput_preempted_recompute_tokens": goodput["preempted_recompute"],
+        "goodput_prefix_saved_tokens": goodput["prefix_saved"],
+        "padding_efficiency": padding_efficiency,
+        "recompiles": recompiles,
         # per-phase wall breakdown (seconds over the measured run)
         "wall_s": round(wall, 3),
         "prefill_steps_s": round(st.time_prefill_steps, 3),

@@ -235,6 +235,27 @@ class LLMEngine:
         if self._decisions_on:
             attach_decision_exporter(self.flight, self.metrics,
                                      plane="engine")
+        # utilization attribution plane (obs/costmodel.py): analytic roofline
+        # costs stamped per dispatch + token-goodput/recompile ledgers. The
+        # knob is read ONCE; off leaves self.util None so every dispatch
+        # site pays a single `is not None` check and nothing else.
+        from llmd_tpu.obs.costmodel import (
+            UtilLedger,
+            attach_util_exporter,
+            util_ledger_enabled,
+        )
+
+        self.util = None
+        if util_ledger_enabled():
+            try:
+                _dev_kind = getattr(jax.devices()[0], "device_kind", "")
+            except Exception:
+                _dev_kind = ""
+            self.util = UtilLedger(
+                model_cfg, device_kind=_dev_kind,
+                quantize_weights=engine_cfg.quantize_weights,
+                kv_cache_dtype=engine_cfg.kv_cache_dtype)
+            attach_util_exporter(self.util, self.metrics)
         # device-plane monitor (obs/device.py): attached by the owning
         # EngineServer at start(); the dispatch loop stamps its heartbeat
         self.monitor = None
@@ -1681,6 +1702,21 @@ class LLMEngine:
         if self._eplb is not None:
             self._eplb_record(cnt)
 
+        # goodput classification reads pre-postprocess sequence state: the
+        # first-chunk prefix credit (num_computed == num_cached_prompt only
+        # holds before the loop advances num_computed) and re-prefill
+        # detection (a prefill chunk on a seq carrying generated tokens is
+        # recompute of preempted work, not fresh compute)
+        util_saved = util_recompute = 0
+        if self.util is not None:
+            for s, n, is_decode in plan:
+                if not is_decode:
+                    if (s.num_computed == s.num_cached_prompt
+                            and s.num_cached_prompt):
+                        util_saved += s.num_cached_prompt
+                    if len(s.token_ids) > s.prompt_len:
+                        util_recompute += n
+
         sample_list: list[tuple[int, Sequence]] = []  # (batch row, seq)
         has_decode_rows = False
         for i, (s, n, is_decode) in enumerate(plan):
@@ -1739,6 +1775,21 @@ class LLMEngine:
             self.metrics.prefill_tokens.inc(n_pre)
         self.metrics.step_duration.labels(phase="unified").observe(
             t3 - t0, exemplar=self._trace_exemplar([s for s, _, _ in plan]))
+        if self.util is not None:
+            # analytic cost from the PACKED shape: the program computes all
+            # NT positions (padding included); KV reads ≈ one pass over each
+            # row's resident KV (exact for decode rows, a lower bound for
+            # chunked prefill), writes = the real positions landed
+            cost = self.util.cost(
+                step_prog, slot_tokens=NT, weight_passes=1,
+                kv_read_tokens=int(lens[: len(plan)].sum()),
+                kv_write_tokens=off)
+            self.util.record(
+                step_prog, cost, t3 - t0,
+                committed=n_dec + n_pre - util_recompute,
+                preempted_recompute=util_recompute,
+                prefix_saved=util_saved,
+                compile_counts=self.programs.compile_counts())
         self._emit_step_spans("unified", [s for s, _, _ in plan], t0_ns,
                               len(plan), n_pre + n_dec)
 
@@ -2069,6 +2120,7 @@ class LLMEngine:
         if self._eplb is not None:
             self._eplb_record(cnt)
         now = time.monotonic()
+        spec_rej0 = self.stats.spec_rejected
         n_tokens = 0
         for s, draft, row0, slot in rows:
             if s.finished or s.slot != slot or self.running[slot] is not s:
@@ -2161,6 +2213,20 @@ class LLMEngine:
             self.metrics.decode_tokens.inc(n_tokens)
         self.metrics.step_duration.labels(phase="spec_verify").observe(
             t3 - t0, exemplar=self._trace_exemplar([s for s, _, _, _ in rows]))
+        if self.util is not None:
+            # verify burns its whole NT budget (PR 15 measured 6.4x padding
+            # here — the standing padding_efficiency series); kept tokens
+            # commit, rejected draft positions are the speculation waste,
+            # rows preempted mid-pack fall into the padding residual
+            cost = self.util.cost(
+                prog, slot_tokens=NT, weight_passes=1,
+                kv_read_tokens=int(lens[: len(plan)].sum()),
+                kv_write_tokens=off)
+            self.util.record(
+                prog, cost, t3 - t0,
+                committed=n_tokens,
+                spec_rejected=self.stats.spec_rejected - spec_rej0,
+                compile_counts=self.programs.compile_counts())
         self._emit_step_spans("spec_verify", [s for s, _, _, _ in rows], t0_ns,
                               len(plan), n_tokens)
 
@@ -2520,7 +2586,20 @@ class LLMEngine:
                 arr.copy_to_host_async()
             except (AttributeError, RuntimeError):
                 break
+        # analytic cost of this call, from its packed shape: the scan runs k
+        # steps over all B slots (masked rows compute too), each step streams
+        # the weights once and each active row reads its resident KV per step.
+        # Stashed on the rec; _decode_process joins it with the measured wall
+        # and the kept-token count when the readback lands.
+        util_cost = None
+        if self.util is not None:
+            util_cost = self.util.cost(
+                prog, slot_tokens=B * k, weight_passes=k,
+                kv_read_tokens=k * int(sum(int(lens_np[s.slot])
+                                           for s in active)),
+                kv_write_tokens=int(steps_left.sum()))
         return {
+            "util_cost": util_cost,
             "rows": [(s, s.slot) for s in active], "prog": prog,
             "toks_out": toks_out, "last_toks": last_toks, "cnt": cnt, "k": k,
             # device-resident chain point for the next pipelined dispatch
@@ -2619,6 +2698,13 @@ class LLMEngine:
             self.metrics.decode_tokens.inc(n_tokens)
         self.metrics.step_duration.labels(phase="decode_process").observe(
             t3 - t1, exemplar=self._trace_exemplar([s for s, _ in rec["rows"]]))
+        if self.util is not None and rec.get("util_cost") is not None:
+            # kept tokens commit; everything else the B x k scan computed
+            # (masked slots, post-EOS steps, rows preempted in flight) is the
+            # padding residual
+            self.util.record(
+                rec["prog"], rec["util_cost"], t3 - t1, committed=n_tokens,
+                compile_counts=self.programs.compile_counts())
         self._emit_step_spans("decode", [s for s, _ in rec["rows"]], t1_ns,
                               len(rec["rows"]), n_tokens)
 

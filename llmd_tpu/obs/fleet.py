@@ -30,13 +30,17 @@ _HBM_USE = "llmd_tpu:device_hbm_bytes_in_use"
 _HBM_LIMIT = "llmd_tpu:device_hbm_limit_bytes"
 _FABRIC = "llmd_tpu:device_fabric_alive"
 _STALLED = "llmd_tpu:engine_stalled"
+_GOODPUT = "llmd_tpu:goodput_tokens_total"
+_MFU = "llmd_tpu:program_mfu"
 
 
 class _ReplicaSample:
     """Last-scrape rollup inputs for one replica. Fixed size by design."""
 
     __slots__ = ("t_mono", "tokens", "tok_per_s", "running", "waiting",
-                 "kv_usage", "hbm_headroom", "fabric_alive", "stalled")
+                 "kv_usage", "hbm_headroom", "fabric_alive", "stalled",
+                 "gp_committed", "gp_all", "gp_committed_delta",
+                 "gp_all_delta", "mfu_mean")
 
     def __init__(self):
         self.t_mono: Optional[float] = None
@@ -48,6 +52,13 @@ class _ReplicaSample:
         self.hbm_headroom: Optional[float] = None
         self.fabric_alive = True
         self.stalled = False
+        # utilization plane: cumulative goodput counters (for deltas) and
+        # the replica's mean per-program MFU sample (None off-device)
+        self.gp_committed: Optional[float] = None
+        self.gp_all: Optional[float] = None
+        self.gp_committed_delta = 0.0
+        self.gp_all_delta = 0.0
+        self.mfu_mean: Optional[float] = None
 
 
 class FleetRollup:
@@ -72,6 +83,8 @@ class FleetRollup:
         fabric: Optional[float] = None
         stalled: Optional[float] = None
         running = waiting = 0.0
+        gp_committed = gp_all = None
+        mfu_samples: list = []
         for name, labels, value in raw:
             if name == _DECODE_TOKENS:
                 tokens = value
@@ -89,12 +102,31 @@ class FleetRollup:
                 fabric = value
             elif name == _STALLED:
                 stalled = value
+            elif name == _GOODPUT:
+                gp_all = (gp_all or 0.0) + value
+                if labels.get("kind") == "committed":
+                    gp_committed = (gp_committed or 0.0) + value
+            elif name == _MFU:
+                mfu_samples.append(value)
         now = self.now_fn()
         if tokens is not None and s.tokens is not None and s.t_mono is not None:
             dt = now - s.t_mono
             delta = tokens - s.tokens
             # counter reset (replica restart) → re-baseline, don't go negative
             s.tok_per_s = delta / dt if dt > 0 and delta >= 0 else 0.0
+        # goodput ratio comes from scrape-to-scrape counter deltas (same
+        # reset discipline as tok_per_s: negative delta = replica restart)
+        if gp_all is not None and s.gp_all is not None:
+            d_all = gp_all - s.gp_all
+            d_com = (gp_committed or 0.0) - (s.gp_committed or 0.0)
+            if d_all >= 0 and d_com >= 0:
+                s.gp_all_delta, s.gp_committed_delta = d_all, d_com
+            else:
+                s.gp_all_delta = s.gp_committed_delta = 0.0
+        s.gp_all = gp_all
+        s.gp_committed = gp_committed
+        s.mfu_mean = (sum(mfu_samples) / len(mfu_samples)
+                      if mfu_samples else None)
         s.t_mono = now
         s.tokens = tokens
         s.running = running
@@ -120,6 +152,9 @@ class FleetRollup:
         reps = list(self._replicas.values())
         headrooms = [s.hbm_headroom for s in reps if s.hbm_headroom is not None]
         kvs = [s.kv_usage for s in reps if s.kv_usage is not None]
+        mfus = [s.mfu_mean for s in reps if s.mfu_mean is not None]
+        gp_all = sum(s.gp_all_delta for s in reps)
+        gp_com = sum(s.gp_committed_delta for s in reps)
         return {
             "replicas": len(reps),
             "tokens_per_second": sum(s.tok_per_s for s in reps),
@@ -130,6 +165,9 @@ class FleetRollup:
             "kv_utilization_mean": sum(kvs) / len(kvs) if kvs else 0.0,
             "fabric_alive": sum(1 for s in reps if s.fabric_alive),
             "stalled": sum(1 for s in reps if s.stalled),
+            # token-weighted fleet goodput over the last scrape interval
+            "goodput_committed_ratio": gp_com / gp_all if gp_all > 0 else 0.0,
+            "mfu_mean": sum(mfus) / len(mfus) if mfus else 0.0,
         }
 
     def running_total(self) -> float:
@@ -156,3 +194,6 @@ class FleetRollup:
         rm.fleet_fabric_alive.set_function(
             lambda: self.snapshot()["fabric_alive"])
         rm.fleet_stalled.set_function(lambda: self.snapshot()["stalled"])
+        rm.fleet_goodput_ratio.set_function(
+            lambda: self.snapshot()["goodput_committed_ratio"])
+        rm.fleet_mfu.set_function(lambda: self.snapshot()["mfu_mean"])

@@ -627,6 +627,60 @@ class EngineMetrics:
             "Per-sequence drafter arm/disarm transitions summed at "
             "retirement (a high flip rate means the acceptance controller "
             "is thrashing)")
+        # Utilization attribution plane (obs/costmodel.py, LLMD_UTIL_LEDGER):
+        # analytic per-dispatch FLOPs/bytes joined with measured step walls.
+        # The MFU/MBU gauges attach scrape-time callbacks against the device-
+        # generation peak table; on CPU (null peaks) the families stay
+        # declared but export no samples.
+        self.program_mfu = reg.gauge(
+            "llmd_tpu:program_mfu",
+            "Model FLOPs utilization per step program over the rolling "
+            "LLMD_UTIL_WINDOW_S window: analytic dispatched FLOPs / "
+            "(window x device peak FLOP/s). Absent when the device "
+            "generation has no peak-table entry (e.g. CPU)",
+            labelnames=("program",))
+        self.program_mbu = reg.gauge(
+            "llmd_tpu:program_mbu",
+            "HBM bandwidth utilization per step program over the rolling "
+            "window: analytic bytes (weight passes + KV page traffic) / "
+            "(window x device peak bytes/s). Absent off-device",
+            labelnames=("program",))
+        self.program_flops = reg.gauge(
+            "llmd_tpu:program_flops_per_second",
+            "Achieved FLOP/s per step program over the rolling window "
+            "(analytic numerator; exported even where peaks are unknown)",
+            labelnames=("program",))
+        self.program_bytes = reg.gauge(
+            "llmd_tpu:program_bytes_per_second",
+            "Achieved HBM bytes/s per step program over the rolling window "
+            "(analytic numerator; exported even where peaks are unknown)",
+            labelnames=("program",))
+        self.goodput_tokens = reg.counter(
+            "llmd_tpu:goodput_tokens_total",
+            "Slot-tokens of every step-program dispatch classified by fate: "
+            "committed | spec_rejected | padding | preempted_recompute | "
+            "prefix_saved. Per program the kinds partition capacity + saved "
+            "tokens, so fractions sum to 1 by construction",
+            labelnames=("program", "kind"))
+        self.padding_efficiency = reg.gauge(
+            "llmd_tpu:program_padding_efficiency",
+            "Real packed positions / slot capacity per step program, "
+            "cumulative ((0,1]; the standing series for verify's NT "
+            "overprovisioning waste)",
+            labelnames=("program",))
+        self.program_compiles = reg.counter(
+            "llmd_tpu:program_compiles_total",
+            "XLA compile-cache entries created per step program "
+            "(compile_counts() deltas observed at dispatch completion; "
+            "growth after warmup = recompile storm)",
+            labelnames=("program",))
+        self.program_compile_seconds = reg.histogram(
+            "llmd_tpu:program_compile_seconds",
+            "Step wall observed when a dispatch completion coincided with a "
+            "compile-cache miss for its program (compile dominates that "
+            "step, so the step wall approximates compile time)",
+            labelnames=("program",),
+            buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
 
 
 class EngineServerMetrics:
@@ -886,6 +940,16 @@ class RouterMetrics:
         self.fleet_stalled = reg.gauge(
             "llmd_tpu:fleet_stalled_replicas",
             "Replicas whose step watchdog currently reports a stall")
+        self.fleet_goodput_ratio = reg.gauge(
+            "llmd_tpu:fleet_goodput_committed_ratio",
+            "Fleet-wide committed fraction of classified slot-tokens from "
+            "scrape-to-scrape goodput-counter deltas (weighted by tokens; "
+            "the one-number answer to how much dispatched compute became "
+            "output)")
+        self.fleet_mfu = reg.gauge(
+            "llmd_tpu:fleet_mfu_mean",
+            "Mean of per-program MFU samples across replicas exporting them "
+            "(absent while no replica runs on a peak-table device)")
 
 
 class PoolMetricsFamilies:

@@ -134,9 +134,9 @@ def test_ci_gate_pins_bench_stages():
     PERF.md Lever 13) must stay declared in ci_gate.py. Pinned by source
     scan because actually running the bench stages is minutes of wall."""
     src = (ROOT / "tools" / "ci_gate.py").read_text()
-    for stage in ("bench-tiny-cpu", "bench-tiny-spec", "bench-tiny-attn",
-                  "bench-tiny-structured", "bench-tiny-spec-structured",
-                  "bench-tiny-warmstart"):
+    for stage in ("util-check", "bench-tiny-cpu", "bench-tiny-spec",
+                  "bench-tiny-attn", "bench-tiny-structured",
+                  "bench-tiny-spec-structured", "bench-tiny-warmstart"):
         assert f'"{stage}"' in src, f"ci_gate.py lost bench stage {stage}"
     # the compose smoke must keep its in-process enforcement flag: without
     # it the stage only proves the bench ran, not that constrained rows

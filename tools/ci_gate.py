@@ -89,6 +89,12 @@ def main() -> int:
             pytest_cmd.append("-x")
         stages.append(("pytest", pytest_cmd, None))
     if not args.skip_bench:
+        # utilization plane: goodput fractions sum to 1 per program, MFU/MBU
+        # families on the null-peak path, recompile counter flat in steady
+        # state, ledger == /metrics token for token. Rides the bench group:
+        # it builds a tiny engine, so the lint-sized always-on roster stays
+        # seconds-fast
+        stages.append(("util-check", [py, "tools/util_check.py"], CPU_ENV))
         stages.append(("bench-tiny-cpu",
                        [py, "bench.py", "--tiny", "--cpu"], None))
         # spec_mode=ngram smoke: the speculative verify path (drafting,

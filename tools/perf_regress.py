@@ -61,6 +61,16 @@ TOLERANCES = {
     "batch": 0.0,
     "isl": 0.0,
     "osl": 0.0,
+    # utilization plane (PR 17): slot-token fate counters are deterministic
+    # for a fixed workload — exact; recompiles must stay at the baseline's
+    # (0 in steady state). padding_efficiency is a HIGHER_BETTER ratio below.
+    "goodput_committed_tokens": 0.0,
+    "goodput_spec_rejected_tokens": 0.0,
+    "goodput_padding_tokens": 0.0,
+    "goodput_preempted_recompute_tokens": 0.0,
+    "goodput_prefix_saved_tokens": 0.0,
+    "recompiles": 0.0,
+    "padding_efficiency": 0.05,
 }
 # Ratios/utilizations vs an external baseline drift when the reference moves;
 # informational only.
@@ -73,7 +83,8 @@ LOWER_BETTER = {"wall_s", "host_pack_us_per_call", "device_ms_per_decode_call",
                 "postprocess_s", "prefill_steps_s", "decode_steps_s",
                 "device_s", "device_decode_s"}
 # Higher-is-better: only the downward direction fails.
-HIGHER_BETTER = {"value", "decode_tok_per_s", "weights_bw_gbs"}
+HIGHER_BETTER = {"value", "decode_tok_per_s", "weights_bw_gbs",
+                 "padding_efficiency"}
 
 PROVENANCE_KEYS = ("device", "point", "weights", "quantize")
 

@@ -25,6 +25,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from llmd_tpu.obs.costmodel import chip_peaks  # noqa: E402
+
 
 def main() -> None:
     ap = argparse.ArgumentParser()
@@ -130,6 +132,9 @@ def main() -> None:
 
         return jax.jit(fn, donate_argnums=(1,))
 
+    # shared peak table (obs/costmodel.py): one source of truth for roofline
+    # context; (None, None) off-table (CPU) degrades the prints gracefully
+    peak_tf, peak_gbs = chip_peaks(jax.devices()[0].device_kind)
     print(f"# {args.model} B={B} k={k} kvlen={kvlen} "
           f"attn={'pallas' if on_tpu else 'xla'} on {jax.devices()[0].device_kind}")
     base = None
@@ -187,8 +192,10 @@ def main() -> None:
             jax.block_until_ready(out)
             t = (time.perf_counter() - t0) / args.reps
             tf = 2 * n_params * B * T / 1e12
+            mfu = f" ({tf/t/peak_tf*100:.0f}% of {peak_tf:.0f} TF/s)" \
+                if peak_tf else ""
             print(f"{mode:16s}: {t*1e3:8.2f} ms for NT={B*T} "
-                  f"-> {B*T/t:,.0f} tok/s, {tf/t:.1f} TF/s")
+                  f"-> {B*T/t:,.0f} tok/s, {tf/t:.1f} TF/s{mfu}")
             del cache
 
     # HBM roofline probe: touch every big weight leaf once per call. A traced
@@ -212,8 +219,9 @@ def main() -> None:
     jax.block_until_ready(out)
     t = (time.perf_counter() - t0) / args.reps
     gb = sum(v.size * v.dtype.itemsize for v in big.values()) / 1e9
+    mbu = f", {gb/t/peak_gbs*100:.0f}% of {peak_gbs:.0f} GB/s" if peak_gbs else ""
     print(f"weights-probe: {t*1e3:8.2f} ms for {gb:.2f} GB -> {gb/t:.0f} GB/s "
-          f"({len(big)} leaves)")
+          f"({len(big)} leaves{mbu})")
 
 
 if __name__ == "__main__":
