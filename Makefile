@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane decisions perf-regress util
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane decisions durable perf-regress util
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -54,6 +54,11 @@ kvplane:
 # regret — 100% coverage over a replayed trace, zero 5xx
 decisions:
 	JAX_PLATFORMS=cpu $(PY) tools/decision_check.py
+
+# durable prefix tier: write-back + store rung survive scale-to-zero and a
+# mid-run store kill — five-rung token identity, zero 5xx
+durable:
+	JAX_PLATFORMS=cpu $(PY) tools/kv_durability_check.py
 
 # utilization plane: per-program goodput sums to 1, MFU/MBU families on the
 # null-peak path, recompile counter flat in steady state, ledger == /metrics

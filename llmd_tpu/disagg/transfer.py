@@ -100,6 +100,7 @@ class KVTransferParams:
     remote_request_id: Optional[str] = None
     num_blocks: int = 0
     block_hashes: list[int] = field(default_factory=list)  # prefix chain to pull
+    tier: str = "peer"  # prefix-pull source: "peer" engine | "durable" store
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "KVTransferParams":
@@ -113,6 +114,7 @@ class KVTransferParams:
             remote_request_id=d.get("remote_request_id"),
             num_blocks=int(d.get("num_blocks", 0)),
             block_hashes=[int(h) for h in d.get("block_hashes") or []],
+            tier=str(d.get("tier") or "peer"),
         )
 
     def to_dict(self) -> dict:

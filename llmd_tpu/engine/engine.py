@@ -292,6 +292,31 @@ class LLMEngine:
             # ever blocking the locked engine step loop
             self._connector_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="kv-connector")
+        # N9: cluster-durable prefix tier — write-back queue + hardened client
+        # over the remote store. Off unless LLMD_KV_DURABLE_STORE is set.
+        self.durable = None
+        self.writeback = None
+        from llmd_tpu.kv.writeback import (DurableStoreClient,
+                                           DurableStoreConfig, WritebackQueue)
+
+        durable_cfg = DurableStoreConfig.from_env()
+        if durable_cfg.enabled:
+            self.durable = DurableStoreClient(durable_cfg)
+            self.writeback = WritebackQueue(
+                self.durable, max_blocks=durable_cfg.queue_blocks)
+            if self.offload is not None:
+                # eviction/demotion paths tee their already-materialized
+                # host bytes into the flush queue (no extra device reads)
+                self.offload.writeback = self.writeback
+            else:
+
+                def _durable_evict(h, pid):
+                    P = self.cfg.num_pages
+                    L = self.cache.shape[0] // P
+                    rows = np.arange(L) * P + pid
+                    self.writeback.offer([h], np.asarray(self.cache[rows])[None])
+
+                self.alloc.evict_hook = _durable_evict
         self.waitq: list[deque[Sequence]] = [deque() for _ in range(R)]
         self.waiting = self.waitq[0]  # rank-0 alias (single-rank compat)
         self.running: list[Optional[Sequence]] = [None] * engine_cfg.max_batch_size

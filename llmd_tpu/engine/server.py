@@ -178,6 +178,25 @@ class EngineServer:
         self.server_metrics.transfer_registrations.set_function(
             lambda: len(self.transfer_source)
             if self.transfer_source is not None else 0)
+        # durable prefix tier (kv/writeback.py): flush-queue depth + breaker
+        # gauges read live state; the flush counter and kv_flush flight event
+        # are driven by the queue's on_flush callback (worker thread)
+        self.server_metrics.kv_durable_queue_depth.set_function(
+            lambda: self.engine.writeback.depth()
+            if getattr(self.engine, "writeback", None) is not None else 0)
+        self.server_metrics.kv_durable_breaker.set_function(
+            lambda: self.engine.durable.breaker_state()
+            if getattr(self.engine, "durable", None) is not None else 0.0)
+        wb = getattr(self.engine, "writeback", None)
+        if wb is not None and wb.on_flush is None:
+
+            def _on_flush(outcome: str, n_blocks: int) -> None:
+                self.server_metrics.kv_durable_flush.labels(
+                    outcome=outcome).inc(n_blocks)
+                self.engine.flight.record_system(
+                    "kv_flush", outcome=outcome, n_blocks=n_blocks)
+
+            wb.on_flush = _on_flush
 
     # -- KV events ---------------------------------------------------------
     def _on_kv_events(self, events: list[KVEvent]) -> None:
@@ -305,6 +324,8 @@ class EngineServer:
             self.monitor.stop()
             self.engine.monitor = None
         self.async_engine.stop()
+        if getattr(self.engine, "writeback", None) is not None:
+            self.engine.writeback.stop()
         if self.transfer_source is not None:
             self.transfer_source.stop()
         if self._runner:
@@ -354,26 +375,41 @@ class EngineServer:
     def _pull_prefix_kv(self, rid: str, ktp: "KVTransferParams",
                         token_ids: list[int], lora_id=None,
                         mm_hashes: list = ()) -> int:
-        """KV-plane prefix pull ahead of prefill. Any failure degrades to the
-        normal admission ladder (host/disk offload tier, then re-prefill) —
-        it NEVER fails the request. Injected blocks become ordinary local
-        prefix hits, so num_cached_prompt stays truthful for free."""
+        """KV-plane prefix pull ahead of prefill: the peer rung first (when
+        the router stamped one), then the cluster-durable store. Any failure
+        degrades to the normal admission ladder (host/disk offload tier, then
+        re-prefill) — it NEVER fails the request. Injected blocks become
+        ordinary local prefix hits, so num_cached_prompt stays truthful."""
         from llmd_tpu.kvplane import pull_prefix_into
 
         self.transfer_stats["prefix_pulls"] += 1
-        self._pending_pulls[rid] = (ktp.remote_host, ktp.remote_port,
-                                    ktp.remote_request_id)
         t0 = time.monotonic()
-        try:
-            n, outcome, released = pull_prefix_into(self, ktp, token_ids,
-                                                    lora_id, mm_hashes)
-        except Exception:
-            n, outcome, released = 0, "error", False
+        tier = getattr(ktp, "tier", "peer") or "peer"
+        peer = f"{ktp.remote_host}:{ktp.remote_port}"
+        n, outcome = 0, "miss"
+        if (tier == "peer" and ktp.remote_host
+                and self.transfer_client is not None):
+            self._pending_pulls[rid] = (ktp.remote_host, ktp.remote_port,
+                                        ktp.remote_request_id)
+            try:
+                n, outcome, released = pull_prefix_into(self, ktp, token_ids,
+                                                        lora_id, mm_hashes)
+            except Exception:
+                n, outcome, released = 0, "error", False
+            if released:
+                self._pending_pulls.pop(rid, None)
+        durable = getattr(self.engine, "durable", None)
+        if n == 0 and durable is not None and ktp.block_hashes:
+            # durable-tier rung: the peer died/missed, or the router stamped
+            # the durable tier directly — the cluster store outlives replicas
+            dn, d_outcome = self._durable_get(ktp.block_hashes, token_ids,
+                                              lora_id, mm_hashes)
+            if dn or tier == "durable":
+                n, outcome, tier = dn, d_outcome, "durable"
+                peer = f"{durable.cfg.host}:{durable.cfg.port}"
         pull_s = time.monotonic() - t0
         self.server_metrics.prefix_pull_seconds.labels(
             outcome=outcome).observe(pull_s)
-        if released:
-            self._pending_pulls.pop(rid, None)
         if n:
             self.transfer_stats["prefix_pull_blocks"] += n
         else:
@@ -381,10 +417,74 @@ class EngineServer:
         # the pull runs before admission opens the flight record; start() is
         # idempotent, so open it here and let add_request backfill the model
         self.engine.flight.start(rid)
+        # durable fetches stay on the kv_pull event NAME — attribution keys
+        # on names (obs/attribution.py), so PR-13 sum-to-wall is untouched;
+        # `tier` is the distinction dashboards and ledger tests filter on
         self.engine.flight.record(rid, "kv_pull", outcome=outcome, blocks=n,
-                                  ms=round(pull_s * 1e3, 3),
-                                  peer=f"{ktp.remote_host}:{ktp.remote_port}")
+                                  ms=round(pull_s * 1e3, 3), tier=tier,
+                                  peer=peer)
         return n
+
+    def _durable_get(self, block_hashes, token_ids, lora_id=None,
+                     mm_hashes: list = ()) -> tuple[int, str]:
+        """Durable-tier rung: fetch the verified consecutive prefix from the
+        cluster store and inject it exactly like a peer pull — hash-chain
+        verified against THIS prompt, shape-checked, committed as ordinary
+        prefix-cache entries. Returns (blocks_injected, kv_pull outcome)."""
+        from llmd_tpu.disagg.transfer import PulledKV, inject_into_engine
+
+        durable = self.engine.durable
+        t0 = time.monotonic()
+        want = [int(h) for h in block_hashes]
+        n, blocks, fetch_outcome = durable.get(want)
+        injected = 0
+        if n and blocks is not None:
+            pulled = PulledKV(block_hashes=want[:n],
+                              token_chunks=[[] for _ in range(n)],
+                              blocks=blocks)
+            try:
+                injected = self.async_engine.run_locked(
+                    lambda: inject_into_engine(self.engine, pulled, token_ids,
+                                               lora_id, list(mm_hashes)))
+            except ValueError:
+                # block-shape / chain mismatch: the verifier rejected the
+                # payload — fall down the ladder, never commit suspect bytes
+                injected, fetch_outcome = 0, "corrupt"
+            except Exception:
+                injected, fetch_outcome = 0, "error"
+            if injected:
+                self.transfer_stats["injected_blocks"] += injected
+        self.engine.flight.record_system(
+            "kv_durable_get", outcome=fetch_outcome, blocks=injected,
+            ms=round((time.monotonic() - t0) * 1e3, 3))
+        self.server_metrics.kv_durable_get.labels(
+            outcome=fetch_outcome).inc()
+        if injected:
+            return injected, "hit"
+        if fetch_outcome in ("ok", "miss", "breaker_open"):
+            return 0, "miss"
+        return 0, "error"
+
+    def _flush_for_drain(self, budget_s: float) -> tuple[int, int]:
+        """Final write-back before retirement: stage the resident prefix
+        working set under the engine lock (cheap device slicing), drain the
+        host bytes off-lock, enqueue, then synchronously empty the flush
+        queue under the remaining budget. A hung store costs at most the
+        budget — the remainder is abandoned, and drain still retires."""
+        from llmd_tpu.disagg.transfer import drain_staged
+        from llmd_tpu.kv.writeback import stage_resident_blocks
+
+        t0 = time.monotonic()
+        wb = self.engine.writeback
+        try:
+            hashes, parts = self.async_engine.run_locked(
+                lambda: stage_resident_blocks(self.engine, wb.max_blocks))
+            if hashes:
+                wb.offer(hashes, drain_staged(parts))
+        except Exception:
+            pass  # flush is best-effort; drain must still retire on time
+        remaining = max(0.0, budget_s - (time.monotonic() - t0))
+        return wb.flush_for_drain(remaining)
 
     def _release_pending_pull(self, rid: str) -> None:
         """Free the peer-side registration for a retired/aborted request
@@ -580,10 +680,11 @@ class EngineServer:
                 rid
             )
         elif (ktp.do_prefix_pull and ktp.block_hashes
-              and self.transfer_client is not None):
-            # KV plane: the router found this prefix cached on a peer — pull
-            # it before admission; failure falls through to the offload tier
-            # and then plain re-prefill
+              and (self.transfer_client is not None
+                   or getattr(self.engine, "durable", None) is not None)):
+            # KV plane: the router found this prefix cached on a peer or in
+            # the durable store — pull it before admission; failure falls
+            # through to the offload tier and then plain re-prefill
             span.add_event("kv_plane.pull")
             await asyncio.get_running_loop().run_in_executor(
                 None, self._pull_prefix_kv, rid, ktp, token_ids, lora_id,
@@ -994,9 +1095,20 @@ class EngineServer:
         while self.engine.seqs and time.monotonic() - t0 < timeout_s:
             await asyncio.sleep(0.02)
         drained = not self.engine.seqs
+        flush_info = {}
+        if drained and getattr(self.engine, "writeback", None) is not None:
+            # write the resident working set back to the durable store before
+            # retirement, capped by min(drain budget, remaining drain window)
+            # so a hung store cannot push retirement past the pool's timeout
+            budget = min(self.engine.durable.cfg.drain_budget_s,
+                         max(0.0, timeout_s - (time.monotonic() - t0)))
+            flushed, abandoned = await asyncio.get_running_loop(
+                ).run_in_executor(None, self._flush_for_drain, budget)
+            flush_info = {"flushed_blocks": flushed,
+                          "abandoned_blocks": abandoned}
         self.engine.flight.record_system(
             "drain_done", drained=drained, inflight=len(self.engine.seqs),
-            waited_ms=round((time.monotonic() - t0) * 1e3, 1))
+            waited_ms=round((time.monotonic() - t0) * 1e3, 1), **flush_info)
         return web.json_response(
             {"status": "drained" if drained else "timeout",
              "inflight": len(self.engine.seqs)},

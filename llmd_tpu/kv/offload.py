@@ -161,6 +161,10 @@ class KVOffloadConnector:
         # gather one logical page's rows across layers. None = single-layer pool.
         self.pages_per_layer = pages_per_layer
         self._load_fn = None  # jitted, built lazily (needs cache shape)
+        # optional durable-tier tee (kv/writeback.py): eviction/demotion
+        # paths re-offer their already-materialized host bytes, so the
+        # cluster store rides the same device reads the local tier pays for
+        self.writeback = None
 
     def _layer_rows(self, cache, page_id):
         """Row indices of logical page `page_id` across layers: l*P + page_id."""
@@ -173,7 +177,10 @@ class KVOffloadConnector:
         """Backstop for demand outrunning the proactive drain: copy an
         about-to-be-recycled page HBM→host (one per-page device sync — the batched
         ``demote_batch`` path is the steady-state eviction route)."""
-        self.store.put(block_hash, np.asarray(cache[self._layer_rows(cache, page_id)]))
+        block = np.asarray(cache[self._layer_rows(cache, page_id)])
+        self.store.put(block_hash, block)
+        if self.writeback is not None:
+            self.writeback.offer([block_hash], block[None])
         if self.flight is not None:
             self.flight.record_system("kv_offload", n_blocks=1, path="evict")
 
@@ -194,6 +201,9 @@ class KVOffloadConnector:
         arr = np.moveaxis(arr, 1, 0)
         for (h, _), block in zip(pairs, arr):
             self.store.put(h, np.ascontiguousarray(block))
+        if self.writeback is not None:
+            self.writeback.offer([h for h, _ in pairs],
+                                 np.ascontiguousarray(arr))
         if self.flight is not None:
             self.flight.record_system("kv_offload", n_blocks=len(pairs),
                                       path="drain")

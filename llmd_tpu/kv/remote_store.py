@@ -24,10 +24,14 @@ Wire protocol (request → response):
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
+import time
+import zlib
 from collections import OrderedDict
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -35,6 +39,20 @@ import numpy as np
 from llmd_tpu.kv.connector_api import KVConnectorBase, register_kv_connector
 
 MAGIC = b"KVS1"
+
+
+@dataclass
+class StoreFaults:
+    """Fault injection for the KVS1 server, in the testing/fake_server.py
+    FaultConfig idiom — chaos tests drive real wire frames, not mocks."""
+
+    error_rate: float = 0.0          # fraction of ops answered {"error": ...}
+    connect_refuse: bool = False     # accept then close before the request
+    latency_s: float = 0.0           # per-op service delay
+    first_byte_delay_s: float = 0.0  # delay before the get response frame
+    corrupt_payload: bool = False    # flip one byte per block (after crc)
+    hangup_rate: float = 0.0         # fraction of gets cut mid-payload
+    seed: int = 0
 
 
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
@@ -59,6 +77,41 @@ def _recv_frame(conn: socket.socket) -> tuple[dict, "socket.socket"]:
     return json.loads(_recv_exact(conn, hlen)), conn
 
 
+def resolve_dtype(name: str) -> np.dtype:
+    """np.dtype(name), extended to accelerator dtypes.
+
+    'bfloat16' / 'float8_*' only resolve after ml_dtypes registers them with
+    numpy. Engine processes get that for free via jax, but the standalone
+    store server never imports jax — without the lazy import here a bf16
+    engine's every put would bounce with "bad put header dtype".
+    """
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes  # noqa: F401  (import registers the names)
+        except ImportError as e:
+            raise TypeError(f"data type {name!r} not understood") from e
+        return np.dtype(name)  # still a TypeError for genuine garbage
+
+
+def verify_crc_prefix(body: bytes, n: int, crcs) -> int:
+    """Longest verified consecutive block prefix of a get payload.
+
+    Truncating at the first checksum mismatch (rather than rejecting the
+    whole payload) keeps the consecutive-prefix property admission relies
+    on: everything before the corrupt block is still committable. A store
+    predating the crc header (no list) passes through unverified.
+    """
+    if not crcs or n <= 0:
+        return max(0, n)
+    per = len(body) // n
+    for i in range(min(n, len(crcs))):
+        if zlib.crc32(body[i * per : (i + 1) * per]) != int(crcs[i]):
+            return i
+    return n
+
+
 class RemoteKVStoreServer:
     """Content-addressed block store with a byte-budget LRU."""
 
@@ -66,13 +119,28 @@ class RemoteKVStoreServer:
                  max_bytes: int = 1 << 30) -> None:
         self.host, self.port = host, port
         self.max_bytes = max_bytes
-        self._blocks: OrderedDict[int, tuple[bytes, str, tuple]] = OrderedDict()
-        self._bytes = 0
+        # guarded-by: _lock — entries are (blob, dtype, shape, crc32)
+        self._blocks: OrderedDict[int, tuple[bytes, str, tuple, int]] = (
+            OrderedDict())
+        self._bytes = 0  # guarded-by: _lock
         self._lock = threading.Lock()
         self._srv: Optional[socket.socket] = None
         self._stop = threading.Event()
+        # guarded-by: _lock
         self.stats = {"puts": 0, "gets": 0, "probes": 0, "evictions": 0,
                       "hit_blocks": 0, "miss_blocks": 0}
+        self.faults = StoreFaults()
+        self._fault_rng = random.Random(self.faults.seed)
+        # guarded-by: _lock
+        self.fault_counts = {"refused": 0, "errors": 0, "hangups": 0,
+                             "corrupted": 0}
+
+    def set_faults(self, **kw) -> None:
+        for k, v in kw.items():
+            if not hasattr(self.faults, k):
+                raise AttributeError(f"unknown fault knob {k!r}")
+            setattr(self.faults, k, v)
+        self._fault_rng = random.Random(self.faults.seed)
 
     def start(self) -> None:
         self._srv = socket.create_server((self.host, self.port))
@@ -105,7 +173,7 @@ class RemoteKVStoreServer:
         # n blocks of the declared dtype/shape
         try:
             expect = (len(hashes) * int(np.prod(shape or (1,)))
-                      * np.dtype(dtype).itemsize)
+                      * resolve_dtype(dtype).itemsize)
         except (TypeError, ValueError) as e:  # np.dtype('bogus') is a TypeError
             raise ValueError(f"bad put header dtype/shape: {e}") from e
         if len(payload) != expect:
@@ -119,10 +187,13 @@ class RemoteKVStoreServer:
                     self._blocks.move_to_end(h)
                     continue
                 blob = payload[i * per : (i + 1) * per]
-                self._blocks[h] = (blob, dtype, tuple(shape))
+                # crc captured at ingest: a get response carries it so clients
+                # can reject payloads corrupted on the wire (or by fault
+                # injection) without trusting the transport
+                self._blocks[h] = (blob, dtype, tuple(shape), zlib.crc32(blob))
                 self._bytes += len(blob)
             while self._bytes > self.max_bytes and self._blocks:
-                _h, (blob, _d, _s) = self._blocks.popitem(last=False)
+                _h, (blob, _d, _s, _c) = self._blocks.popitem(last=False)
                 self._bytes -= len(blob)
                 self.stats["evictions"] += 1
             self.stats["puts"] += 1
@@ -140,8 +211,8 @@ class RemoteKVStoreServer:
                 out.append(h)
         return out
 
-    def _get(self, hashes: list[int]) -> tuple[list[int],
-                                               list[tuple[bytes, str, tuple]]]:
+    def _get(self, hashes: list[int]) -> tuple[
+            list[int], list[tuple[bytes, str, tuple, int]]]:
         """Consecutive prefix AND its blobs under ONE critical section.
 
         Scanning the prefix and fetching the blobs under separate lock
@@ -150,7 +221,7 @@ class RemoteKVStoreServer:
         non-consecutive payload positionally under the consecutive hash chain.
         """
         have: list[int] = []
-        blobs: list[tuple[bytes, str, tuple]] = []
+        blobs: list[tuple[bytes, str, tuple, int]] = []
         with self._lock:
             for h in hashes:
                 entry = self._blocks.get(h)
@@ -172,10 +243,27 @@ class RemoteKVStoreServer:
                              daemon=True).start()
 
     def _serve_one(self, conn: socket.socket) -> None:
+        f = self.faults
         try:
             with conn:
+                if f.connect_refuse:
+                    # accept-then-slam: the client's next read raises
+                    # ConnectionError, same failure class as a refused connect
+                    with self._lock:
+                        self.fault_counts["refused"] += 1
+                    return
+                if f.latency_s:
+                    time.sleep(f.latency_s)
                 hdr, _ = _recv_frame(conn)
                 op = hdr.get("op")
+                if f.error_rate and self._fault_rng.random() < f.error_rate:
+                    if op == "put":  # drain the payload so the socket is clean
+                        _recv_exact(conn, int(hdr.get("nbytes", 0)))
+                    with self._lock:
+                        self.fault_counts["errors"] += 1
+                    _send_frame(conn, {"error": "injected fault",
+                                       "stored": 0, "found": 0})
+                    return
                 if op == "put":
                     payload = _recv_exact(conn, int(hdr["nbytes"]))
                     try:
@@ -200,12 +288,34 @@ class RemoteKVStoreServer:
                         self.stats["hit_blocks"] += len(have)
                         self.stats["miss_blocks"] += len(hashes) - len(have)
                         self.stats["gets"] += 1
-                    payload = b"".join(b for b, _d, _s in blobs)
-                    meta = blobs[0] if blobs else (b"", "float32", ())
-                    _send_frame(conn, {"found": len(blobs),
-                                       "dtype": meta[1],
-                                       "shape": list(meta[2]),
-                                       "nbytes": len(payload)}, payload)
+                    payload = b"".join(b for b, _d, _s, _c in blobs)
+                    meta = blobs[0] if blobs else (b"", "float32", (), 0)
+                    resp = {"found": len(blobs),
+                            "dtype": meta[1],
+                            "shape": list(meta[2]),
+                            "crc": [c for _b, _d, _s, c in blobs],
+                            "nbytes": len(payload)}
+                    if f.first_byte_delay_s:
+                        time.sleep(f.first_byte_delay_s)
+                    if f.corrupt_payload and payload:
+                        # flip a byte per block AFTER the crc list was built:
+                        # the client's checksum verify is what must catch it
+                        per = len(payload) // max(1, len(blobs))
+                        buf = bytearray(payload)
+                        for i in range(len(blobs)):
+                            buf[i * per] ^= 0xFF
+                        payload = bytes(buf)
+                        with self._lock:
+                            self.fault_counts["corrupted"] += 1
+                    if (payload and f.hangup_rate
+                            and self._fault_rng.random() < f.hangup_rate):
+                        hdrb = json.dumps(resp).encode()
+                        conn.sendall(MAGIC + struct.pack("<I", len(hdrb))
+                                     + hdrb + payload[: len(payload) // 2])
+                        with self._lock:
+                            self.fault_counts["hangups"] += 1
+                        return  # with-block slams the socket mid-frame
+                    _send_frame(conn, resp, payload)
                 elif op == "stats":
                     with self._lock:
                         _send_frame(conn, {**self.stats,
@@ -304,10 +414,18 @@ class RemoteKVConnector(KVConnectorBase):
         try:
             resp, body = self._rpc({"op": "get", "hashes": want})
             n = int(resp.get("found", 0))
-            self._record(ok=True)
+            if n == 0:
+                self._record(ok=True)
+                return cache, 0
+            n = verify_crc_prefix(body, n, resp.get("crc"))
+            # a corrupt payload is a store-path failure (repeats should trip
+            # the breaker), but the verified consecutive prefix is still good
+            self._record(ok=n == int(resp["found"]))
             if n == 0:
                 return cache, 0
-            blocks = np.frombuffer(body, dtype=resp["dtype"]).reshape(
+            per = len(body) // int(resp["found"])
+            blocks = np.frombuffer(body[: n * per],
+                                   dtype=resolve_dtype(resp["dtype"])).reshape(
                 (n, *resp["shape"]))
             cache = insert_blocks(cache, page_ids[:n], blocks, pages_per_layer)
             return cache, n

@@ -135,7 +135,12 @@ class KVPlane:
         self.stats = {
             "precise_requests": 0, "degraded_requests": 0,
             "lookups": 0, "lookup_hits": 0, "pulls_planned": 0,
+            "durable_pulls_planned": 0,
         }
+        # durable-tier probe: a DurableStoreClient (kv/writeback.py) the
+        # ladder consults when no live peer qualifies — the store outlives
+        # replica churn, so its answer survives where the index's cannot
+        self.durable_probe = None
         self._feed_batches = -1  # last observed subscriber batch count
         self._feed_seen_t = time.monotonic()
 
@@ -144,7 +149,15 @@ class KVPlane:
         mode = plane_mode()
         thr = int(os.environ.get("LLMD_KV_PLANE_PULL_THRESHOLD_BLOCKS", "4"))
         stale = float(os.environ.get("LLMD_KV_PLANE_STALE_S", "30"))
-        return cls(mode, ctx, pool, pull_threshold_blocks=thr, stale_s=stale)
+        plane = cls(mode, ctx, pool, pull_threshold_blocks=thr, stale_s=stale)
+        if mode == "precise":
+            from llmd_tpu.kv.writeback import (DurableStoreClient,
+                                               DurableStoreConfig)
+
+            durable_cfg = DurableStoreConfig.from_env()
+            if durable_cfg.enabled:
+                plane.durable_probe = DurableStoreClient(durable_cfg)
+        return plane
 
     @property
     def active(self) -> bool:
@@ -259,9 +272,12 @@ class KVPlane:
         for addr, h in hits.items():
             if addr != target_address and h > peer_tokens:
                 peer_addr, peer_tokens = addr, int(h)
-        if peer_addr is None:
-            return None
         bs = max(1, self.block_size)
+        if peer_addr is None:
+            # no live peer holds more than the target: the durable-tier rung.
+            # The store's probe (tight deadline, breaker-guarded) stands in
+            # for the index — its contents survive the churn that emptied it.
+            return self._plan_durable_pull(req, keys, target_tokens, bs)
         if peer_tokens - target_tokens < self.pull_threshold_blocks * bs:
             return None
         ep = self.pool.get(peer_addr)
@@ -288,4 +304,28 @@ class KVPlane:
             # against kv_transfer_prefix_pull_seconds actually spent.
             "peer": peer_addr,
             "saved_tokens_est": peer_tokens - target_tokens,
+        }
+
+    def _plan_durable_pull(self, req: InferenceRequest, keys: list[int],
+                           target_tokens: int, bs: int) -> Optional[dict]:
+        """Durable-store rung of the pull ladder: probe the cluster store for
+        the consecutive prefix and stamp a tier="durable" pull when it beats
+        the target by the same threshold a peer would have to. The engine
+        resolves the stamp against its own client — the router never moves
+        KV bytes, it only routes the decision."""
+        if self.durable_probe is None:
+            return None
+        found = self.durable_probe.probe(keys)
+        if found <= 0:
+            return None
+        if found * bs - target_tokens < self.pull_threshold_blocks * bs:
+            return None
+        self.stats["durable_pulls_planned"] += 1
+        return {
+            "do_prefix_pull": True,
+            "tier": "durable",
+            "num_blocks": found,
+            "block_hashes": keys[:found],
+            "peer": "durable-store",
+            "saved_tokens_est": found * bs - target_tokens,
         }

@@ -77,6 +77,8 @@ EVENT_CATALOG = (
     "kv_reload",
     "kv_offload",
     "kv_pull",
+    "kv_flush",
+    "kv_durable_get",
     "retired",
     "aborted",
     "drain_start",

@@ -712,6 +712,25 @@ class EngineServerMetrics:
             labelnames=("outcome",),
             buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                      1.0, 2.5))
+        # durable prefix tier (kv/writeback.py): flush counter is in BLOCKS
+        # (abandoned = queued blocks dropped at the drain-flush deadline);
+        # the get counter is in fetch OPS
+        self.kv_durable_flush = reg.counter(
+            "llmd_tpu:kv_durable_flush_total",
+            "Prefix blocks written back to the durable store, by outcome "
+            "(ok|error|dropped|abandoned)",
+            labelnames=("outcome",))
+        self.kv_durable_get = reg.counter(
+            "llmd_tpu:kv_durable_get_total",
+            "Durable-tier prefix fetches, by outcome "
+            "(ok|miss|corrupt|error|breaker_open)",
+            labelnames=("outcome",))
+        self.kv_durable_queue_depth = reg.gauge(
+            "llmd_tpu:kv_durable_queue_depth",
+            "Blocks waiting in the write-back flush queue")
+        self.kv_durable_breaker = reg.gauge(
+            "llmd_tpu:kv_durable_breaker_state",
+            "Durable-store circuit breaker (0 closed, 0.5 half-open, 1 open)")
 
 
 class RouterMetrics:
@@ -813,6 +832,9 @@ class RouterMetrics:
         self.kvplane_pulls_stamped = reg.counter(
             "llm_d_epp_kv_plane_pulls_stamped_total",
             "Cross-engine prefix pulls stamped onto forwarded requests")
+        self.kvplane_durable_pulls_stamped = reg.counter(
+            "llm_d_epp_kv_plane_durable_pulls_stamped_total",
+            "Durable-store prefix pulls stamped when no live peer qualified")
         self.kvplane_index_blocks = reg.gauge(
             "llm_d_epp_kv_plane_index_blocks",
             "Block-hash keys resident in the router's KV index")

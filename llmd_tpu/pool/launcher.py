@@ -77,7 +77,8 @@ class FakeReplicaLauncher(ReplicaLauncher):
                  snapshots: Optional[PoolSnapshotStore] = None,
                  engine_config: Optional[dict] = None,
                  engine_build_s: float = 0.0,
-                 restore_s: float = 0.0) -> None:
+                 restore_s: float = 0.0,
+                 durable_store: bool = False) -> None:
         from llmd_tpu.testing.fake_server import FakeServerConfig
 
         self.server_config = server_config or FakeServerConfig()
@@ -89,6 +90,15 @@ class FakeReplicaLauncher(ReplicaLauncher):
         }
         self.engine_build_s = engine_build_s
         self.restore_s = restore_s
+        # Durable prefix tier stand-in (docs/durable-tier.md): a graceful
+        # stop — the controller only calls stop() after the drain handshake —
+        # writes the replica's simulated block set back here, and a warm
+        # launch restores it, so a 0→1 warm start recovers the prefix working
+        # set, not just the compile cache. kill() deliberately skips the
+        # write-back (no drain, no flush). Off by default: only opted-in
+        # harnesses (tools/slo_check.py) should see restored prefixes.
+        self.durable_store = durable_store
+        self.durable_blocks: set[int] = set()
         self._seq = 0
 
     async def launch(self) -> ReplicaHandle:
@@ -106,6 +116,13 @@ class FakeReplicaLauncher(ReplicaLauncher):
                 self.snapshots.save(fp, {"kind": "fake",
                                          "engine_config": self.engine_config})
         server = FakeModelServer(copy.deepcopy(self.server_config))
+        if self.durable_store and self.durable_blocks:
+            # restore the written-back prefix working set into the simulated
+            # paged cache: repeats hit these blocks, so prefill (∝ uncached
+            # tokens) — and therefore TTFT — recovers along with the build
+            now = time.monotonic()
+            for h in self.durable_blocks:
+                server.blocks[h] = now
         await server.start()
         self._seq += 1
         return ReplicaHandle(address=server.address,
@@ -114,13 +131,19 @@ class FakeReplicaLauncher(ReplicaLauncher):
 
     async def stop(self, handle: ReplicaHandle) -> None:
         if handle.server is not None:
+            if self.durable_store:
+                # drain-time write-back: the controller drained before this
+                self.durable_blocks.update(handle.server.blocks.keys())
             await handle.server.stop()
             handle.server = None
 
     async def kill(self, handle: ReplicaHandle) -> None:
         # aiohttp cleanup cancels in-flight handlers: clients see resets,
-        # which is the abrupt-death signal the chaos gate wants
-        await self.stop(handle)
+        # which is the abrupt-death signal the chaos gate wants. No durable
+        # write-back: an abrupt death never ran the drain flush.
+        if handle.server is not None:
+            server, handle.server = handle.server, None
+            await server.stop()
 
     def alive(self, handle: ReplicaHandle) -> bool:
         return handle.server is not None and handle.server._runner is not None

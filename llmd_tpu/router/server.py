@@ -277,6 +277,8 @@ class RouterServer:
             lambda: plane.stats["lookup_hits"])
         self.metrics.kvplane_pulls_stamped.set_function(
             lambda: plane.stats["pulls_planned"])
+        self.metrics.kvplane_durable_pulls_stamped.set_function(
+            lambda: plane.stats.get("durable_pulls_planned", 0))
         self.metrics.kvplane_index_blocks.set_function(
             lambda: len(plane.index) if plane.index is not None else 0)
         self.metrics.kvplane_feed_age.set_function(plane.feed_age_s)

@@ -240,6 +240,130 @@ def test_abort_releases_peer_registration(monkeypatch):
     run_async(_release_scenario(monkeypatch))
 
 
+# ------------------------------------------------------- durable-tier rung
+async def _durable_ladder_scenario(monkeypatch):
+    """Rung 3 of the five-rung ladder (docs/kv-plane.md): the cluster store
+    outlives the replica that wrote it — a drained source's working set
+    serves a fresh engine token-identically; a corrupt or dead store falls
+    down-ladder to re-prefill, never a client error."""
+    from llmd_tpu.kv.remote_store import RemoteKVStoreServer
+
+    store = RemoteKVStoreServer()
+    store.start()
+    monkeypatch.setenv("LLMD_KV_PLANE", "precise")
+    monkeypatch.setenv("LLMD_KV_DURABLE_STORE", f"127.0.0.1:{store.port}")
+    cfg = get_model_config("tiny")
+    source = EngineServer(cfg, _engine_cfg(), model_name="m",
+                          host="127.0.0.1", port=0)
+    target = EngineServer(cfg, _engine_cfg(), model_name="m",
+                          host="127.0.0.1", port=0)
+    monkeypatch.delenv("LLMD_KV_DURABLE_STORE")
+    control = EngineServer(cfg, _engine_cfg(), model_name="m",
+                           host="127.0.0.1", port=0)
+    await source.start()
+    await target.start()
+    await control.start()
+    try:
+        assert source.engine.durable is not None
+        assert control.engine.durable is None
+        async with aiohttp.ClientSession() as sess:
+            # write-back: warm the source, then drain — the resident working
+            # set must land in the store before the replica retires
+            await _gen(sess, source.address, PROMPT_A)
+            await _gen(sess, source.address, PROMPT_B)
+            expected = (await _gen(sess, control.address,
+                                   PROMPT_A))["choices"][0]["text"]
+            r = await sess.post(f"http://{source.address}/drain?timeout_s=10")
+            assert (await r.json())["status"] == "drained"
+            n_blocks = len(_hashes(PROMPT_A))
+            assert source.engine.durable.probe(_hashes(PROMPT_A)) == n_blocks
+
+            # durable get: a fresh engine (no peer, no transfer client)
+            # serves the prefix from the store, token-identical
+            ktp = {"do_prefix_pull": True, "tier": "durable",
+                   "num_blocks": n_blocks, "block_hashes": _hashes(PROMPT_A)}
+            got = await _gen(sess, target.address, PROMPT_A, ktp)
+            assert got["choices"][0]["text"] == expected
+            assert got["usage"]["cached_tokens"] == _reusable(PROMPT_A)
+            assert _flight_outcomes(target, got["id"]) == [("hit", n_blocks)]
+            rec = target.engine.flight.get(got["id"])
+            pull_ev = [e for e in rec["events"] if e["event"] == "kv_pull"][0]
+            assert pull_ev["tier"] == "durable"
+
+            # corrupt store: checksum verify rejects; request still completes
+            # token-identical by re-prefilling (zero client errors)
+            store.set_faults(corrupt_payload=True)
+            expected_b = (await _gen(sess, control.address,
+                                     PROMPT_B))["choices"][0]["text"]
+            ktp_b = {"do_prefix_pull": True, "tier": "durable",
+                     "num_blocks": len(_hashes(PROMPT_B)),
+                     "block_hashes": _hashes(PROMPT_B)}
+            got = await _gen(sess, target.address, PROMPT_B, ktp_b)
+            assert got["choices"][0]["text"] == expected_b
+            assert got["usage"]["cached_tokens"] == 0
+            store.set_faults(corrupt_payload=False)
+
+            # dead store: breaker degrades to plain re-prefill, still 200
+            store.stop()
+            expected_c = (await _gen(sess, control.address,
+                                     PROMPT_C))["choices"][0]["text"]
+            ktp_c = {"do_prefix_pull": True, "tier": "durable",
+                     "num_blocks": len(_hashes(PROMPT_C)),
+                     "block_hashes": _hashes(PROMPT_C)}
+            got = await _gen(sess, target.address, PROMPT_C, ktp_c)
+            assert got["choices"][0]["text"] == expected_c
+            assert got["usage"]["cached_tokens"] == 0
+    finally:
+        store.stop()
+        await source.stop()
+        await target.stop()
+        await control.stop()
+
+
+def test_kv_plane_durable_tier_rung(monkeypatch):
+    run_async(_durable_ladder_scenario(monkeypatch))
+
+
+async def _drain_hung_store_scenario(monkeypatch):
+    """Acceptance: drain against a hung store completes within its timeout —
+    the flush budget clamps every put attempt, and the blocks that never
+    landed are counted abandoned on the drain_done event."""
+    from llmd_tpu.kv.remote_store import RemoteKVStoreServer
+
+    store = RemoteKVStoreServer()
+    store.start()
+    monkeypatch.setenv("LLMD_KV_PLANE", "precise")
+    monkeypatch.setenv("LLMD_KV_DURABLE_STORE", f"127.0.0.1:{store.port}")
+    monkeypatch.setenv("LLMD_KV_DURABLE_OP_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("LLMD_KV_DURABLE_DRAIN_BUDGET_S", "0.6")
+    cfg = get_model_config("tiny")
+    eng = EngineServer(cfg, _engine_cfg(), model_name="m",
+                       host="127.0.0.1", port=0)
+    await eng.start()
+    try:
+        async with aiohttp.ClientSession() as sess:
+            await _gen(sess, eng.address, PROMPT_A)
+            store.set_faults(latency_s=30.0)  # hung: never answers in time
+            t0 = asyncio.get_event_loop().time()
+            r = await sess.post(f"http://{eng.address}/drain?timeout_s=5")
+            waited = asyncio.get_event_loop().time() - t0
+            assert (await r.json())["status"] == "drained"
+            assert waited < 3.0  # budget, not the store, bounds the drain
+        done = [e for e in eng.engine.flight.system_events()
+                if e["event"] == "drain_done"]
+        assert done and done[-1]["abandoned_blocks"] > 0
+        assert done[-1]["flushed_blocks"] == 0
+        assert eng.engine.writeback.counts["abandoned"] > 0
+    finally:
+        store.set_faults(latency_s=0.0)
+        store.stop()
+        await eng.stop()
+
+
+def test_drain_hung_store_honors_budget(monkeypatch):
+    run_async(_drain_hung_store_scenario(monkeypatch))
+
+
 # ------------------------------------------------------------ mode semantics
 APPROX_CFG = """
 plugins:
@@ -340,6 +464,58 @@ def test_plan_pull_threshold_and_side_channel():
     # peer without an advertised side channel → no pull
     req.state[STATE_KV_PLANE] = "precise"
     pool.upsert(Endpoint(address="10.0.0.9:8000"))  # labels gone
+    assert plane.plan_pull(req, "10.0.0.1:80") is None
+
+
+def test_plan_pull_durable_rung():
+    """No live peer qualifies → the store probe plans a tier="durable" stamp
+    under the same advantage threshold a peer must clear."""
+    pool = EndpointPool()
+    plane = KVPlane("precise", {}, pool, pull_threshold_blocks=2)
+    plane.block_size = 8
+
+    class _Probe:
+        def __init__(self):
+            self.found = 6
+            self.calls = []
+
+        def probe(self, keys):
+            self.calls.append(list(keys))
+            return self.found
+
+    probe = _Probe()
+    plane.durable_probe = probe
+    req = InferenceRequest(model="m", prompt="z" * 64)
+    keys = list(range(100, 108))
+    req.state[STATE_KV_PLANE] = "precise"
+    req.state[STATE_BLOCK_KEYS] = keys
+    req.state[STATE_PREFIX_HITS] = {"10.0.0.1:80": 8}  # target only, no peer
+    plan = plane.plan_pull(req, "10.0.0.1:80")
+    assert plan is not None
+    assert plan["tier"] == "durable"
+    assert plan["block_hashes"] == keys[:6] and plan["num_blocks"] == 6
+    assert plan["peer"] == "durable-store"
+    assert plan["saved_tokens_est"] == 6 * 8 - 8
+    assert "remote_host" not in plan
+    assert probe.calls == [keys]
+    assert plane.stats["durable_pulls_planned"] == 1
+    # store advantage below the threshold → no stamp
+    probe.found = 2
+    assert plane.plan_pull(req, "10.0.0.1:80") is None
+    # empty store → no stamp
+    probe.found = 0
+    assert plane.plan_pull(req, "10.0.0.1:80") is None
+    # a qualifying live peer wins the rung over the store
+    probe.found = 6
+    pool.upsert(Endpoint(address="10.0.0.9:8000",
+                         labels={LABEL_KV_TRANSFER_PORT: "7000"}))
+    req.state[STATE_PREFIX_HITS] = {"10.0.0.9:8000": 48, "10.0.0.1:80": 8}
+    plan = plane.plan_pull(req, "10.0.0.1:80")
+    assert plan is not None and "tier" not in plan
+    assert plan["peer"] == "10.0.0.9:8000"
+    # no probe configured (LLMD_KV_DURABLE_STORE unset) → ladder ends at peer
+    plane.durable_probe = None
+    req.state[STATE_PREFIX_HITS] = {"10.0.0.1:80": 8}
     assert plane.plan_pull(req, "10.0.0.1:80") is None
 
 

@@ -1,0 +1,338 @@
+"""Durable-tier plumbing: DurableStoreClient (deadlines, retry, breaker),
+WritebackQueue (bounded drop-oldest, drain-budget flush), and the store
+server's fault injection — all over real KVS1 frames where a store is
+involved (testing/fake_server.py FaultConfig idiom, applied to the store)."""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from llmd_tpu.kv.remote_store import (RemoteKVStoreServer, StoreFaults,
+                                      resolve_dtype, verify_crc_prefix)
+from llmd_tpu.kv.writeback import (DurableStoreClient, DurableStoreConfig,
+                                   WritebackQueue)
+
+
+def _blocks(n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((n, 4, 8)).astype(np.float32)
+
+
+def _client(port: int, **kw) -> DurableStoreClient:
+    cfg = DurableStoreConfig(host="127.0.0.1", port=port,
+                             op_timeout_s=kw.pop("op_timeout_s", 1.0),
+                             probe_timeout_s=kw.pop("probe_timeout_s", 0.5),
+                             retries=kw.pop("retries", 0),
+                             backoff_ms=1.0, backoff_max_ms=5.0, **kw)
+    return DurableStoreClient(cfg)
+
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# ---------------------------------------------------------------- config
+def test_config_from_env(monkeypatch):
+    monkeypatch.delenv("LLMD_KV_DURABLE_STORE", raising=False)
+    assert not DurableStoreConfig.from_env().enabled
+    monkeypatch.setenv("LLMD_KV_DURABLE_STORE", "10.0.0.5:7777")
+    monkeypatch.setenv("LLMD_KV_DURABLE_RETRIES", "5")
+    monkeypatch.setenv("LLMD_KV_DURABLE_DRAIN_BUDGET_S", "1.5")
+    cfg = DurableStoreConfig.from_env()
+    assert cfg.enabled and (cfg.host, cfg.port) == ("10.0.0.5", 7777)
+    assert cfg.retries == 5 and cfg.drain_budget_s == 1.5
+    # bare port → loopback host; garbage → disabled, never a crash
+    monkeypatch.setenv("LLMD_KV_DURABLE_STORE", ":7777")
+    assert DurableStoreConfig.from_env().host == "127.0.0.1"
+    monkeypatch.setenv("LLMD_KV_DURABLE_STORE", "garbage")
+    assert not DurableStoreConfig.from_env().enabled
+
+
+def test_verify_crc_prefix():
+    import zlib
+
+    body = b"aaaabbbbcccc"
+    crcs = [zlib.crc32(body[i:i + 4]) for i in (0, 4, 8)]
+    assert verify_crc_prefix(body, 3, crcs) == 3
+    assert verify_crc_prefix(body, 3, [crcs[0], 0, crcs[2]]) == 1
+    assert verify_crc_prefix(body, 3, [0, crcs[1], crcs[2]]) == 0
+    assert verify_crc_prefix(body, 3, None) == 3  # legacy header: unverified
+
+
+# ---------------------------------------------------------------- client
+def test_client_round_trip_and_miss():
+    srv = RemoteKVStoreServer()
+    srv.start()
+    try:
+        cli = _client(srv.port)
+        assert cli.put([1, 2, 3], _blocks(3)) == "ok"
+        assert cli.probe([1, 2, 3, 99]) == 3
+        n, got, outcome = cli.get([1, 2, 3])
+        assert (n, outcome) == (3, "ok")
+        np.testing.assert_array_equal(got, _blocks(3))
+        assert cli.get([42]) == (0, None, "miss")
+        assert cli.breaker_state() == 0.0
+    finally:
+        srv.stop()
+
+
+def test_accelerator_dtype_round_trips_through_standalone_store():
+    # the standalone store CLI never imports jax, so numpy has not had
+    # 'bfloat16' registered by ml_dtypes — a bf16 engine's puts all bounced
+    # with "bad put header dtype" until resolve_dtype imported it lazily.
+    # A subprocess (not an in-process server) is the only honest repro: this
+    # pytest process imports jax, which registers the name everywhere.
+    ml_dtypes = pytest.importorskip("ml_dtypes")
+    port = _dead_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "llmd_tpu.kv.remote_store",
+         "--host", "127.0.0.1", "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        assert "remote KV store" in proc.stdout.readline()
+        cli = _client(port, op_timeout_s=5.0)
+        arr = _blocks(3).astype(ml_dtypes.bfloat16)
+        assert cli.put([1, 2, 3], arr) == "ok"
+        n, got, outcome = cli.get([1, 2, 3])
+        assert (n, outcome) == (3, "ok")
+        assert got.dtype == arr.dtype
+        np.testing.assert_array_equal(got, arr)
+    finally:
+        proc.kill()
+        proc.wait(10)
+
+
+def test_resolve_dtype_rejects_garbage():
+    assert resolve_dtype("float32") == np.float32
+    with pytest.raises(TypeError):
+        resolve_dtype("not_a_dtype")
+
+
+def test_client_crc_truncates_to_verified_prefix():
+    srv = RemoteKVStoreServer()
+    srv.start()
+    try:
+        cli = _client(srv.port)
+        assert cli.put([1, 2, 3], _blocks(3)) == "ok"
+        # flip the stored checksum of block 2: the payload no longer verifies
+        # past block 1, so the client serves the consecutive good prefix
+        blob, d, sh, crc = srv._blocks[2]
+        srv._blocks[2] = (blob, d, sh, crc ^ 1)
+        n, got, outcome = cli.get([1, 2, 3])
+        assert (n, outcome) == (1, "corrupt")
+        np.testing.assert_array_equal(got, _blocks(3)[:1])
+        assert cli.stats["corrupt"] == 1
+    finally:
+        srv.stop()
+
+
+def test_breaker_opens_skips_and_recovers():
+    srv = RemoteKVStoreServer()
+    srv.start()
+    dead = _dead_port()
+    try:
+        cli = _client(dead, breaker_failures=2, breaker_cooldown_s=0.2)
+        assert cli.probe([1]) == 0
+        assert cli.probe([1]) == 0  # second consecutive failure trips
+        assert cli.breaker_state() == 1.0
+        assert cli.stats["breaker_trips"] == 1
+        # open: every op skips instantly, typed outcome — never an exception
+        assert cli.get([1]) == (0, None, "breaker_open")
+        assert cli.put([1], _blocks(1)) == "breaker_open"
+        assert cli.stats["breaker_skips"] >= 2
+        # cooldown → half-open single trial against a recovered store closes
+        time.sleep(0.25)
+        cli.cfg.port = srv.port
+        assert cli.probe([1]) == 0  # miss, but the op succeeded
+        assert cli.breaker_state() == 0.0
+        # half-open trial failing re-opens without needing N failures
+        cli.cfg.port = dead
+        cli.probe([1])
+        cli.probe([1])
+        assert cli.breaker_state() == 1.0
+        time.sleep(0.25)
+        cli.probe([1])
+        assert cli.breaker_state() == 1.0
+    finally:
+        srv.stop()
+
+
+def test_breaker_rate_path():
+    cli = _client(1, breaker_failures=1000, breaker_window=10,
+                  breaker_failure_rate=0.5, breaker_min_volume=4)
+    for ok in (True, True, False):
+        cli._record(ok)
+    assert cli.breaker_state() == 0.0  # below min volume
+    cli._record(False)  # 2/4 failures >= 0.5 with volume met
+    assert cli.breaker_state() == 1.0
+
+
+def test_get_retries_with_full_jitter_then_errors():
+    cli = _client(_dead_port(), retries=2, breaker_failures=100)
+    t0 = time.monotonic()
+    assert cli.get([1]) == (0, None, "error")
+    assert time.monotonic() - t0 < 2.0  # jitter base 1ms: retries are cheap
+    assert cli.stats["errors"] == 3  # initial + 2 retries all recorded
+    # jitter is bounded by min(base * 2^k, cap)
+    for attempt in range(6):
+        assert 0.0 <= cli._jitter_s(attempt) <= 0.005
+
+
+# ------------------------------------------------------- fault injection
+def test_store_fault_knobs():
+    srv = RemoteKVStoreServer()
+    srv.start()
+    try:
+        cli = _client(srv.port, breaker_failures=100)
+        assert cli.put([7, 8], _blocks(2, seed=1)) == "ok"
+
+        with pytest.raises(AttributeError):
+            srv.set_faults(not_a_knob=1.0)
+
+        srv.set_faults(error_rate=1.0)
+        assert cli.put([9], _blocks(1)) == "error"
+        assert cli.get([7]) == (0, None, "error")
+        assert srv.fault_counts["errors"] >= 2
+
+        srv.set_faults(error_rate=0.0, connect_refuse=True)
+        assert cli.probe([7]) == 0
+        assert srv.fault_counts["refused"] >= 1
+
+        srv.set_faults(connect_refuse=False, hangup_rate=1.0)
+        assert cli.get([7, 8])[2] == "error"  # payload truncated mid-frame
+        assert srv.fault_counts["hangups"] >= 1
+
+        srv.set_faults(hangup_rate=0.0, corrupt_payload=True)
+        n, got, outcome = cli.get([7, 8])
+        assert (n, got, outcome) == (0, None, "corrupt")
+        assert srv.fault_counts["corrupted"] >= 1
+
+        srv.set_faults(corrupt_payload=False, first_byte_delay_s=0.02)
+        n, got, outcome = cli.get([7, 8])
+        assert (n, outcome) == (2, "ok")
+        np.testing.assert_array_equal(got, _blocks(2, seed=1))
+    finally:
+        srv.stop()
+
+
+def test_store_faults_unknown_knob_is_attribute_error():
+    f = StoreFaults()
+    with pytest.raises(AttributeError):
+        RemoteKVStoreServer().set_faults(latencyz=1.0)
+    assert f.error_rate == 0.0  # defaults inert
+
+
+# ------------------------------------------------------------- the queue
+class _StubClient:
+    """Duck-typed store client: records puts, optional gate/outcome hooks."""
+
+    def __init__(self, outcome="ok"):
+        self.cfg = DurableStoreConfig(host="x", port=1, op_timeout_s=0.2)
+        self.puts = []
+        self.outcome = outcome
+        self.gate = None
+        self.started = threading.Event()
+
+    def put(self, hashes, blocks, timeout=None, retries=None):
+        self.started.set()
+        if self.gate is not None:
+            self.gate.wait(5.0)
+        self.puts.append((list(hashes), timeout, retries))
+        if callable(self.outcome):
+            return self.outcome(timeout)
+        return self.outcome
+
+
+def test_queue_flushes_async_and_drops_oldest():
+    cli = _StubClient()
+    cli.gate = threading.Event()
+    events = []
+    q = WritebackQueue(cli, max_blocks=4,
+                       on_flush=lambda o, n: events.append((o, n)))
+    try:
+        arr = _blocks(2)
+        q.offer([1, 2], arr)
+        assert cli.started.wait(2.0)  # worker holds [1, 2] out of the queue
+        q.offer([3, 4], arr)
+        q.offer([5, 6], arr)
+        q.offer([7, 8], arr)  # depth 6 > 4: oldest queued entry [3, 4] drops
+        assert q.counts["dropped"] == 2 and q.depth() == 4
+        assert ("dropped", 2) in events
+        cli.gate.set()
+        deadline = time.monotonic() + 5.0
+        while q.depth() > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.05)  # let the worker finish the last _flush_one
+        assert q.counts["ok"] == 6 and q.counts["error"] == 0
+        assert sorted(h for hs, _t, _r in cli.puts for h in hs) == [
+            1, 2, 5, 6, 7, 8]
+        assert events.count(("ok", 2)) == 3
+    finally:
+        cli.gate.set()
+        q.stop()
+
+
+def test_flush_for_drain_within_budget():
+    cli = _StubClient()
+    q = WritebackQueue(cli, max_blocks=64)
+    try:
+        cli.gate = threading.Event()
+        q.offer([99], _blocks(1))  # parked in the worker, not the queue
+        assert cli.started.wait(2.0)
+        gate, cli.gate = cli.gate, None
+        q.offer([1, 2], _blocks(2))
+        q.offer([3, 4], _blocks(2))
+        flushed, abandoned = q.flush_for_drain(5.0)
+        gate.set()
+        assert (flushed, abandoned) == (4, 0)
+        # drain-time puts clamp to the remaining budget with no retries
+        assert all(r == 0 and t is not None and t <= 5.0
+                   for _h, t, r in cli.puts[-2:])
+    finally:
+        q.stop()
+
+
+def test_flush_for_drain_abandons_on_hung_store():
+    # a "hung" store: every put burns its full per-attempt timeout and fails
+    cli = _StubClient(outcome=lambda t: (time.sleep(min(t or 0.2, 2.0)),
+                                         "error")[1])
+    events = []
+    q = WritebackQueue(cli, max_blocks=64,
+                       on_flush=lambda o, n: events.append((o, n)))
+    try:
+        cli.gate = threading.Event()
+        q.offer([99], _blocks(1))  # park the worker so it cannot race us
+        assert cli.started.wait(2.0)
+        gate, cli.gate = cli.gate, None
+        for i in range(6):
+            q.offer([10 + 2 * i, 11 + 2 * i], _blocks(2))
+        t0 = time.monotonic()
+        flushed, abandoned = q.flush_for_drain(0.5)
+        elapsed = time.monotonic() - t0
+        gate.set()
+        # every block that did not land — failed drain puts AND the queue
+        # remainder at the deadline — is abandoned (the replica retires)
+        assert (flushed, abandoned) == (0, 12)
+        assert q.counts["abandoned"] == 12
+        assert elapsed < 1.5  # budget held: hung store cannot stall drain
+        assert q.depth() == 0
+        assert any(o == "abandoned" and n == abandoned for o, n in events)
+    finally:
+        q.stop()
+
+
+def test_queue_stop_rejects_offers():
+    q = WritebackQueue(_StubClient(), max_blocks=4)
+    q.stop()
+    assert q.offer([1], _blocks(1)) is False

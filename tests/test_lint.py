@@ -124,7 +124,8 @@ def test_ci_gate_composes_stages():
     assert [s["stage"] for s in summary["stages"]] == [
         "lint-envvars", "lint-metrics", "lint-events", "llmd-lint",
         "validate-manifests", "chaos-check", "structured-check", "slo-check",
-        "device-obs", "kv-plane-check", "decision-check", "perf-regress"]
+        "device-obs", "kv-plane-check", "decision-check",
+        "kv-durability-check", "perf-regress"]
     assert all(s["ok"] for s in summary["stages"])
 
 

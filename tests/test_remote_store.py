@@ -119,7 +119,7 @@ def test_get_prefix_and_blobs_atomic():
         # evict the MIDDLE block directly, then get: the consecutive contract
         # means only [7] may be served, never [7, 9] positionally
         with srv._lock:
-            blob, _d, _sh = srv._blocks.pop(8)
+            blob, _d, _sh, _crc = srv._blocks.pop(8)
             srv._bytes -= len(blob)
         resp, body = conn._rpc({"op": "get", "hashes": [7, 8, 9]})
         assert resp["found"] == 1

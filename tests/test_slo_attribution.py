@@ -73,6 +73,29 @@ def test_engine_ledger_sums_to_wall_with_kv_pull_and_preemption():
     assert ledger["residual_frac"] == 0.0
 
 
+def test_engine_ledger_ignores_kv_pull_tier_attr():
+    """Durable-tier fetches ride the existing kv_pull event NAME with a
+    tier attr (PR 18); attribution keys on names only, so the ledger is
+    bit-identical to a peer pull and still sums to wall."""
+    events = [
+        ("kv_pull", 5.0, {"tier": "durable", "outcome": "hit",
+                          "peer": "10.0.0.9:9400", "n_blocks": 6}),
+        ("arrival", 7.0), ("admitted", 10.0), ("prefill_start", 11.0),
+        ("prefill_end", 40.0), ("first_token", 42.0), ("decode", 43.0),
+        ("retired", 100.0),
+    ]
+    durable = build_ledger(_rec(events, wall_ms=100.0))
+    peer = build_ledger(_rec(
+        [(n, t, {**a, "tier": "peer"}) if len(e) > 2 else e
+         for e in events
+         for n, t, a in [(e[0], e[1], e[2] if len(e) > 2 else {})]],
+        wall_ms=100.0))
+    assert abs(_total(durable) - 100.0) < 1e-6
+    assert durable["phases"] == peer["phases"]
+    assert durable["phases"]["kv_pull"] == 5.0
+    assert durable["residual_frac"] == 0.0
+
+
 def test_router_ledger_sums_to_wall_under_retry_and_hedge():
     rec = _rec([
         ("arrival", 2.0), ("flow_enqueue", 3.0), ("flow_dispatch", 40.0),

@@ -76,6 +76,10 @@ def main() -> int:
         # ledger, regret + calibration families exported, zero 5xx, and the
         # ledger stays inside the router-overhead bound
         ("decision-check", [py, "tools/decision_check.py"], CPU_ENV),
+        # durable prefix tier: five-rung token identity, scale-to-zero ->
+        # scale-up restores the working set from the store (>= 90% of repeat
+        # prefixes skip recompute), store killed mid-run with zero 5xx
+        ("kv-durability-check", [py, "tools/kv_durability_check.py"], CPU_ENV),
         # perf contract: the pinned campaign point must agree with the pinned
         # BENCH baseline under per-metric tolerances — catches accidental edits
         # to either artifact and keeps the comparator itself exercised

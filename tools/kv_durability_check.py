@@ -1,0 +1,327 @@
+#!/usr/bin/env python3
+"""KV-durability gate: the cluster prefix tier survives replica churn.
+
+End-to-end over REAL tiny-model engines (CPU jax, no hardware) and a real
+``RemoteKVStoreServer`` speaking KVS1 — the same servers production wires
+together, so write-back, crc + hash-chain verification, the circuit breaker,
+and drain-time flushing are all exercised on actual frames.
+
+Asserts, per ISSUE 18's acceptance criteria:
+
+1. **five-rung token identity** — local HBM hit, peer pull, durable-tier get,
+   local offload tier, and re-prefill (including the corrupt-store
+   down-ladder) all produce greedy output token-identical to a plain engine;
+2. **scale-to-zero -> scale-up with the store alive** — the last replica
+   drains (write-back flush), dies, and a fresh replica serves >= 90% of
+   repeat-prefix requests without recomputing the prefix (a durable-less
+   control replica recomputes every one);
+3. **mid-run store kill** — the store is killed halfway through a replay and
+   every request still completes 200 with token-identical output (the
+   breaker degrades the rung; zero client 5xx).
+
+Run: python tools/kv_durability_check.py  (CI: tools/ci_gate.py stage
+`kv-durability-check`; ``make durable``.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the durable tier rides the precise KV plane; precise also makes engines
+# prefer the python transfer transport, which speaks pull_prefix (rung 2)
+os.environ["LLMD_KV_PLANE"] = "precise"
+# tight client envelope: the gate must finish in seconds even while the store
+# is dead, so attempts are short and the breaker trips fast
+os.environ.setdefault("LLMD_KV_DURABLE_OP_TIMEOUT_S", "1.0")
+os.environ.setdefault("LLMD_KV_DURABLE_PROBE_TIMEOUT_S", "0.25")
+os.environ.setdefault("LLMD_KV_DURABLE_RETRIES", "1")
+os.environ.setdefault("LLMD_KV_DURABLE_BACKOFF_MS", "5")
+os.environ.setdefault("LLMD_KV_DURABLE_BREAKER_FAILURES", "2")
+os.environ.setdefault("LLMD_KV_DURABLE_BREAKER_COOLDOWN_S", "30")
+
+HIT_FLOOR = 0.90
+BLOCK = 8          # engine page_size below
+N_GROUPS = 4
+REPEATS = 3
+PROMPTS = [
+    f"group-{g:02d} " + ("shared conversation context " * 3)[: 8 * BLOCK]
+    for g in range(N_GROUPS)
+]
+
+
+def _engine_cfg(**kw):
+    from llmd_tpu.engine.config import EngineConfig
+
+    base = dict(page_size=BLOCK, num_pages=64, max_model_len=256,
+                max_batch_size=4, prefill_chunk=32)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _hashes(prompt: str) -> list[int]:
+    from llmd_tpu.core.kv_events import block_keys_for_tokens
+
+    return block_keys_for_tokens(list(prompt.encode()), BLOCK)
+
+
+def _reusable(prompt: str) -> int:
+    """Tokens a full-prefix restore credits: whole blocks minus the final
+    token (its logit must be recomputed)."""
+    n = len(prompt.encode())
+    full = (n // BLOCK) * BLOCK
+    return full - BLOCK if full == n else full
+
+
+async def _gen(sess, addr: str, prompt: str, ktp=None) -> tuple[int, dict]:
+    import aiohttp
+
+    body = {"model": "m", "prompt": prompt, "max_tokens": 8, "temperature": 0}
+    if ktp:
+        body["kv_transfer_params"] = ktp
+    try:
+        async with sess.post(f"http://{addr}/v1/completions", json=body,
+                             timeout=aiohttp.ClientTimeout(total=30)) as r:
+            return r.status, (await r.json() if r.status == 200 else {})
+    except Exception:
+        return 599, {}
+
+
+def _durable_stamp(probe, prompt: str):
+    """The router rung's stand-in: probe the store, stamp tier="durable"."""
+    keys = _hashes(prompt)
+    found = probe.probe(keys)
+    if found <= 0:
+        return None
+    return {"do_prefix_pull": True, "tier": "durable", "num_blocks": found,
+            "block_hashes": keys[:found]}
+
+
+async def main_async() -> int:
+    import aiohttp
+
+    from llmd_tpu.engine.server import EngineServer
+    from llmd_tpu.kv.remote_store import RemoteKVStoreServer
+    from llmd_tpu.kv.writeback import DurableStoreClient, DurableStoreConfig
+    from llmd_tpu.models import get_model_config
+
+    store = RemoteKVStoreServer()
+    store.start()
+    os.environ["LLMD_KV_DURABLE_STORE"] = f"127.0.0.1:{store.port}"
+    model = get_model_config("tiny")
+
+    def _engine(durable=True, transfer=False, **cfg_kw) -> EngineServer:
+        if not durable:
+            os.environ.pop("LLMD_KV_DURABLE_STORE", None)
+        try:
+            return EngineServer(
+                model, _engine_cfg(**cfg_kw), model_name="m",
+                host="127.0.0.1", port=0,
+                kv_transfer_port=0 if transfer else None)
+        finally:
+            os.environ["LLMD_KV_DURABLE_STORE"] = f"127.0.0.1:{store.port}"
+
+    checks: dict[str, bool] = {}
+    detail: dict = {}
+    statuses: list[int] = []
+    engines: list[EngineServer] = []
+
+    async def _up(srv: EngineServer) -> EngineServer:
+        await srv.start()
+        engines.append(srv)
+        return srv
+
+    verdict = {"kv_durability_check": "failed"}
+    try:
+        control = await _up(_engine(durable=False))
+        async with aiohttp.ClientSession() as sess:
+            expected = {}
+            for p in PROMPTS:
+                st, body = await _gen(sess, control.address, p)
+                statuses.append(st)
+                expected[p] = body["choices"][0]["text"]
+
+            # ---- phase 1: five-rung token identity ------------------------
+            a = await _up(_engine(transfer=True))
+            b = await _up(_engine(transfer=True))
+            p0, p1 = PROMPTS[0], PROMPTS[1]
+            texts = {}
+
+            st, body = await _gen(sess, a.address, p0)  # cold prefill
+            statuses.append(st)
+            st, body = await _gen(sess, a.address, p0)  # rung 1: local hit
+            statuses.append(st)
+            texts["rung1_local"] = body["choices"][0]["text"]
+            rung1_cached = body["usage"]["cached_tokens"] >= _reusable(p0)
+
+            st, _ = await _gen(sess, b.address, p1)  # warm the peer
+            statuses.append(st)
+            peer_ktp = {"do_prefix_pull": True,
+                        "remote_host": "127.0.0.1",
+                        "remote_port": b.transfer_source.port,
+                        "remote_request_id": "durability-gate-peer",
+                        "num_blocks": len(_hashes(p1)),
+                        "block_hashes": _hashes(p1)}
+            st, body = await _gen(sess, a.address, p1, peer_ktp)  # rung 2
+            statuses.append(st)
+            texts["rung2_peer"] = body["choices"][0]["text"]
+            rung2_cached = body["usage"]["cached_tokens"] >= _reusable(p1)
+
+            # rung 3: drain A (write-back flush) -> fresh replica pulls the
+            # store; rung 4: an offload-tier engine evicts to host and reloads
+            async with sess.post(f"http://{a.address}/drain?timeout_s=15") as r:
+                drained = (await r.json())["status"] == "drained"
+            probe = DurableStoreClient(DurableStoreConfig.from_env())
+            c = await _up(_engine())
+            st, body = await _gen(sess, c.address, p0, _durable_stamp(probe, p0))
+            statuses.append(st)
+            texts["rung3_durable"] = body["choices"][0]["text"]
+            rung3_cached = body["usage"]["cached_tokens"] >= _reusable(p0)
+
+            d = await _up(_engine(durable=False, cpu_offload_pages=64,
+                                  num_pages=16))
+            for p in PROMPTS:  # small HBM: earlier groups evict to host tier
+                st, _ = await _gen(sess, d.address, p)
+                statuses.append(st)
+            st, body = await _gen(sess, d.address, PROMPTS[0])  # rung 4
+            statuses.append(st)
+            texts["rung4_offload"] = body["choices"][0]["text"]
+            rung4_cached = body["usage"]["cached_tokens"] > 0
+
+            # rung 5: corrupt store -> crc/chain verify rejects, re-prefill
+            store.set_faults(corrupt_payload=True)
+            e5 = await _up(_engine())
+            st, body = await _gen(sess, e5.address, p1,
+                                  _durable_stamp(probe, p1))
+            statuses.append(st)
+            texts["rung5_reprefill"] = body["choices"][0]["text"]
+            rung5_recompute = body["usage"]["cached_tokens"] == 0
+            store.set_faults(corrupt_payload=False)
+            corrupted = store.fault_counts["corrupted"]
+
+            ident = {
+                "rung1_local": texts["rung1_local"] == expected[p0],
+                "rung2_peer": texts["rung2_peer"] == expected[p1],
+                "rung3_durable": texts["rung3_durable"] == expected[p0],
+                "rung4_offload": texts["rung4_offload"] == expected[p0],
+                "rung5_reprefill": texts["rung5_reprefill"] == expected[p1],
+            }
+            checks["five_rung_token_identity"] = all(ident.values())
+            checks["rung_credits"] = (rung1_cached and rung2_cached
+                                      and rung3_cached and rung4_cached
+                                      and rung5_recompute and drained
+                                      and corrupted > 0)
+            detail["rung_identity"] = ident
+            detail["rung_credits"] = {
+                "rung1_local": rung1_cached, "rung2_peer": rung2_cached,
+                "rung3_durable": rung3_cached, "rung4_offload": rung4_cached,
+                "rung5_recomputed": rung5_recompute,
+                "drain_flushed": drained, "store_corruptions_served": corrupted,
+            }
+
+            # ---- phase 2: scale-to-zero -> scale-up, store alive ----------
+            # the LAST replica drains and dies; a fresh one must restore the
+            # working set from the store (control: durable-less replica
+            # recomputes everything)
+            warm = await _up(_engine())
+            for p in PROMPTS:
+                st, _ = await _gen(sess, warm.address, p)
+                statuses.append(st)
+            async with sess.post(
+                    f"http://{warm.address}/drain?timeout_s=15") as r:
+                drained2 = (await r.json())["status"] == "drained"
+            await warm.stop()  # scale to zero
+            engines.remove(warm)
+
+            cold_ctrl = await _up(_engine(durable=False))
+            fresh = await _up(_engine())
+            served, total, ctrl_served = 0, 0, 0
+            for rep in range(REPEATS):
+                for p in PROMPTS:
+                    st, body = await _gen(sess, fresh.address, p,
+                                          _durable_stamp(probe, p))
+                    statuses.append(st)
+                    total += 1
+                    ok_text = body["choices"][0]["text"] == expected[p]
+                    if (body["usage"]["cached_tokens"] >= _reusable(p)
+                            and ok_text):
+                        served += 1
+                    if rep == 0:
+                        st, cb = await _gen(sess, cold_ctrl.address, p)
+                        statuses.append(st)
+                        if cb["usage"]["cached_tokens"] >= _reusable(p):
+                            ctrl_served += 1
+            hit_ratio = served / max(1, total)
+            checks["scale_to_zero_restore"] = (drained2
+                                               and hit_ratio >= HIT_FLOOR)
+            checks["durable_less_control_recomputes"] = ctrl_served == 0
+            detail["scale_to_zero"] = {
+                "drained": drained2, "repeat_prefix_requests": total,
+                "no_recompute": served, "hit_ratio": round(hit_ratio, 4),
+                "hit_floor": HIT_FLOOR,
+                "control_no_recompute": ctrl_served,
+            }
+
+            # ---- phase 3: store killed mid-replay -------------------------
+            victim = await _up(_engine())
+            stamps = [_durable_stamp(probe, p) for p in PROMPTS]
+            kill_ok = True
+            n_before_kill = 0
+            for rep in range(REPEATS):
+                if rep == 1:
+                    store.stop()  # hard kill, no drain
+                for p, ktp in zip(PROMPTS, stamps):
+                    st, body = await _gen(sess, victim.address, p, ktp)
+                    statuses.append(st)
+                    if st != 200 or body["choices"][0]["text"] != expected[p]:
+                        kill_ok = False
+                    elif rep == 0:
+                        n_before_kill += 1
+            breaker = victim.engine.durable.breaker_state()
+            checks["store_kill_zero_5xx"] = kill_ok
+            detail["store_kill"] = {
+                "served_before_kill": n_before_kill,
+                "breaker_state_after": breaker,
+                "client_errors": sum(1 for s in statuses if s >= 500),
+            }
+
+        n_5xx = sum(1 for s in statuses if s >= 500)
+        checks["zero_5xx"] = n_5xx == 0
+        ok = all(checks.values())
+        verdict = {
+            "kv_durability_check": "ok" if ok else "failed",
+            "requests": len(statuses),
+            "client_5xx": n_5xx,
+            "checks": checks,
+            **detail,
+        }
+    finally:
+        for srv in engines:
+            try:
+                await srv.stop()
+            except Exception:
+                pass
+        store.stop()
+
+    print(json.dumps(verdict, indent=2))
+    if verdict["kv_durability_check"] != "ok":
+        print(f"kv_durability_check: FAILED — checks: {checks}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def main() -> int:
+    argparse.ArgumentParser(description=__doc__).parse_args()
+    return asyncio.run(main_async())
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,14 @@ HOT_PATHS: dict[str, object] = {
         "route",
         "quiesced",
     ],
+    # Hot-path exclusions audit (PR 18): kv/writeback.py is deliberately NOT
+    # listed. The only serving-path-adjacent entry point is
+    # WritebackQueue.offer (evict/demote tee) — an append under a condition
+    # variable with zero socket/device work; every blocking call (store RPC,
+    # retry sleep) lives on the dedicated kv-writeback worker thread or in
+    # drain-time flushing, which runs in the server's executor off the step
+    # loop. DurableStoreClient.probe is router-side (kvplane/plane.py), not
+    # engine-step code. If offer() ever grows IO, list the file here.
 }
 
 # Direct device->host synchronization spellings. float()/int()/bool() on

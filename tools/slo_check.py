@@ -16,7 +16,11 @@ Asserts, per ISSUE 7's acceptance criteria:
 2. ZERO client-visible 5xx / transport errors,
 3. the pool scales up under the burst and returns to the floor after it,
 4. a 0→1 warm start (snapshot restore) beats the cold engine build in the
-   reported warm-start metric.
+   reported warm-start metric,
+5. the warm start restores repeat-prefix TTFT too (PR 18 durable tier): the
+   graceful scale-to-zero drained — write-back — so the woken replica serves
+   a pre-drain prefix without recomputing it (cached-token parity; the fake's
+   prefill cost ∝ uncached tokens, so cached parity is TTFT parity).
 
 Run: python tools/slo_check.py  (CI: tools/ci_gate.py stage `slo-check`;
 ``--full`` runs a longer trace for local investigation.)
@@ -144,6 +148,7 @@ async def main_async(full: bool) -> int:
             max_running=4),
         snapshots=store,
         engine_build_s=0.7,  # simulated cold engine build the snapshot skips
+        durable_store=True,  # drain write-back + warm restore (PR 18 tier)
     )
 
     pool = EndpointPool()
@@ -187,6 +192,22 @@ async def main_async(full: bool) -> int:
             await asyncio.sleep(0.2)
         at_floor = len(controller.replicas) == floor
 
+        # repeat-prefix probe: warm a distinctive prefix on the floor pool so
+        # the durable tier has something to carry across scale-to-zero
+        import aiohttp
+
+        prefix_prompt = "durable repeat prefix probe " * 8
+        async with aiohttp.ClientSession() as sess:
+            for _ in range(2):
+                async with sess.post(
+                    f"http://{router.address}/v1/completions",
+                    json={"prompt": prefix_prompt, "max_tokens": 4,
+                          "model": "fake/model"},
+                    timeout=aiohttp.ClientTimeout(total=20),
+                ) as r:
+                    pre_drain = await r.json()
+        pre_drain_cached = int(pre_drain["usage"]["cached_tokens"])
+
         # 0→1 warm start: drop to zero, then one request wakes the pool
         controller.variant.min_replicas = 0
         controller.cfg.scale_to_zero = True
@@ -194,7 +215,6 @@ async def main_async(full: bool) -> int:
         controller.wva.enforcer.scale_to_zero = True
         await controller.scale_to(0, reason="scale_to_zero")
         assert len(controller.replicas) == 0
-        import aiohttp
 
         n_before = len(controller.launch_records)
         t_wake = time.monotonic()
@@ -212,6 +232,24 @@ async def main_async(full: bool) -> int:
                         if rec.kind == "warm"]
         warm_launch_s = warm_records[0].seconds if warm_records else None
 
+        # the warm start must restore repeat-prefix TTFT, not just compile
+        # time: the graceful scale-to-zero drained (write-back), so the woken
+        # replica serves the probe prefix from the durable tier. In the fake's
+        # timing model prefill ∝ uncached tokens — cached parity IS TTFT
+        # parity with the pre-drain repeat.
+        async with aiohttp.ClientSession() as sess:
+            async with sess.post(
+                f"http://{router.address}/v1/completions",
+                json={"prompt": prefix_prompt, "max_tokens": 4,
+                      "model": "fake/model"},
+                timeout=aiohttp.ClientTimeout(total=20),
+            ) as r:
+                post_wake = await r.json() if r.status == 200 else {}
+        post_wake_cached = int((post_wake.get("usage") or {})
+                               .get("cached_tokens", 0))
+        prefix_restored = (pre_drain_cached > 0
+                          and post_wake_cached >= pre_drain_cached)
+
         scale_events = [e for e in router.flight.system_events()
                         if e["event"].startswith("pool_")]
         attainment_ok = report.slo_attainment >= ATTAINMENT_FLOOR
@@ -222,7 +260,8 @@ async def main_async(full: bool) -> int:
                            and warm_0_to_1_s < cold_0_to_1_s)
         ledgers_ok = n_finished > 0 and n_ledgered == n_finished
         ok = (attainment_ok and zero_5xx and scaled_up and at_floor
-              and wake_status == 200 and warm_beats_cold and ledgers_ok)
+              and wake_status == 200 and warm_beats_cold and ledgers_ok
+              and prefix_restored)
         verdict = {
             "slo_check": "ok" if ok else "failed",
             "trace": {"duration_s": duration_s, "base_rps": base_rps,
@@ -241,6 +280,8 @@ async def main_async(full: bool) -> int:
             "engine_build_s": launcher.engine_build_s,
             "warm_beats_cold": warm_beats_cold,
             "wake_status": wake_status,
+            "repeat_prefix_cached": {"pre_drain": pre_drain_cached,
+                                     "post_wake": post_wake_cached},
             "launches": controller.status()["launches"],
             "pool_events": len(scale_events),
             "decision_ledgers": {"finished": n_finished,
@@ -250,6 +291,7 @@ async def main_async(full: bool) -> int:
                 "scaled_up": scaled_up, "returned_to_floor": at_floor,
                 "warm_beats_cold": warm_beats_cold,
                 "decision_ledgers": ledgers_ok,
+                "warm_prefix_restored": prefix_restored,
             },
         }
     finally:
