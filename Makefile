@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane decisions durable perf-regress util
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane decisions durable perf-regress util moe
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -64,6 +64,12 @@ durable:
 # null-peak path, recompile counter flat in steady state, ledger == /metrics
 util:
 	JAX_PLATFORMS=cpu $(PY) tools/util_check.py
+
+# MoE dispatch plane: tiny-moe engine A/B — sorted path selected under auto,
+# greedy parity vs the einsum reference, zero drops on sorted, provable drops
+# on capacity-starved einsum, counter == engine ledger
+moe:
+	JAX_PLATFORMS=cpu $(PY) tools/moe_check.py
 
 # perf contract: pinned campaign point vs pinned BENCH baseline under
 # per-metric tolerances (tools/perf_regress.py --run gates a fresh bench)

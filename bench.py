@@ -193,6 +193,13 @@ def main() -> None:
                          "structured x speculative compose (Lever 13): "
                          "grammar-masked verify accepts drafts on constrained "
                          "rows (pair with --spec-mode ngram)")
+    ap.add_argument("--moe-dispatch", default="auto",
+                    choices=["auto", "sorted", "einsum"],
+                    help="MoE token dispatch: sorted = token-sorted drop-free "
+                         "path (ops/moe_dispatch), einsum = legacy capacity "
+                         "dispatch (the parity reference, silently drops past "
+                         "capacity); auto = sorted. Dense models ignore it — "
+                         "the moe-sorted/moe-einsum campaign A/B lever")
     ap.add_argument("--assert-spec-structured", action="store_true",
                     help="fail unless constrained rows accepted >0 draft "
                          "tokens AND the run had 0 structured violations — "
@@ -235,7 +242,8 @@ def main() -> None:
                 and args.kv_layout == "auto" and args.spec_mode == "off" \
                 and args.spec_tokens is None and args.workload == "uniform" \
                 and args.attn_impl == "auto" and args.pack_overlap == "on" \
-                and args.structured_fused == "on" and args.chain_depth is None
+                and args.structured_fused == "on" and args.chain_depth is None \
+                and args.moe_dispatch == "auto"
             if flag_default:
                 try:
                     import glob as _glob
@@ -329,6 +337,8 @@ def main() -> None:
     chain_explicit = (args.attn_impl != "auto" or args.pack_overlap != "on"
                       or args.structured_fused != "on"
                       or args.chain_depth is not None)
+    moe_explicit = args.moe_dispatch != "auto"
+    eng_cfg.moe_dispatch = args.moe_dispatch
     eng_cfg.attn_impl = args.attn_impl
     eng_cfg.pack_overlap = args.pack_overlap == "on"
     eng_cfg.structured_fused_decode = args.structured_fused == "on"
@@ -452,7 +462,10 @@ def main() -> None:
               + (f" (fallback: {eng.attn_fallback_reason})" if eng.attn_fallback_reason else "")
               + (f" tune={eng.attn_tune_hash}" if eng.attn_tune_hash else ""),
               file=sys.stderr)
-        print(f"# moe_backend={eng.moe_backend}", file=sys.stderr)
+        print(f"# moe_backend={eng.moe_backend} moe_dispatch={eng.moe_dispatch}"
+              + (f" (fallback: {eng.moe_dispatch_fallback_reason})"
+                 if eng.moe_dispatch_fallback_reason else ""),
+              file=sys.stderr)
         t0 = time.monotonic()
         eng.generate(prompts(2, salt=1, tok=tok), _sampling())
         print(f"# warmup/compile {time.monotonic() - t0:.1f}s", file=sys.stderr)
@@ -462,13 +475,14 @@ def main() -> None:
         eng.stats = EngineStats(attn_backend=eng.stats.attn_backend,
                                 attn_tune_hash=eng.stats.attn_tune_hash,
                                 moe_backend=eng.stats.moe_backend,
+                                moe_dispatch=eng.stats.moe_dispatch,
                                 kv_cache_dtype=eng.stats.kv_cache_dtype,
                                 kv_layout=eng.stats.kv_layout)
         # utilization-ledger baseline: registry counters can't reset, so the
         # goodput/recompile provenance keys report measured-window DELTAS
         # against this post-warmup snapshot (matching the stats reset above)
         eng.util_bench_base = (
-            (eng.util.totals(), eng.util.compiles())
+            (eng.util.totals(), eng.util.compiles(), eng.util.moe_comm_total())
             if eng.util is not None else None)
         t0 = time.monotonic()
         out = eng.generate(prompts(n_req, salt=2, tok=tok), sp)
@@ -647,7 +661,7 @@ def main() -> None:
         # r03-proven shape and measure that instead
         if (tiny or args.batch or args.decode_steps or args.isl or args.osl
                 or args.layer_unroll or quantize_explicit or kv_explicit
-                or spec_explicit or chain_explicit):
+                or spec_explicit or chain_explicit or moe_explicit):
             # an explicitly requested shape or quantization must not silently
             # re-measure as something else (e.g. bf16 under an "int8" label)
             raise
@@ -728,9 +742,12 @@ def main() -> None:
     # token-goodput + recompile provenance over the measured window (deltas
     # against the post-warmup ledger snapshot; None with LLMD_UTIL_LEDGER off)
     goodput = {k: None for k in GOODPUT_KINDS}
-    padding_efficiency = recompiles = None
+    padding_efficiency = recompiles = moe_comm_bytes = None
     if eng.util is not None and getattr(eng, "util_bench_base", None) is not None:
-        base_tokens, base_compiles = eng.util_bench_base
+        base_tokens, base_compiles, base_moe_comm = eng.util_bench_base
+        # measured-window MoE all-to-all traffic (same accumulator that
+        # feeds program_mbu, so ledger == scrape by construction)
+        moe_comm_bytes = round(eng.util.moe_comm_total() - base_moe_comm)
         goodput = {k: 0 for k in GOODPUT_KINDS}
         for prog_name, tk in eng.util.totals().items():
             base = base_tokens.get(prog_name, {})
@@ -790,6 +807,9 @@ def main() -> None:
         "attn_fallback_reason": eng.attn_fallback_reason,
         "attn_tune_hash": eng.attn_tune_hash,
         "moe_backend": eng.moe_backend,
+        "moe_dispatch": eng.moe_dispatch,
+        "moe_dropped_tokens": eng.stats.moe_dropped_tokens,
+        "moe_comm_bytes": moe_comm_bytes,
         "device": getattr(dev, "device_kind", str(dev)),
         "weights_bw_gbs": round(achieved_gbs, 1),
         "weights_bw_util": round(achieved_gbs / peak_gbs, 3),

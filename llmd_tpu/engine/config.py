@@ -97,6 +97,12 @@ class EngineConfig:
     # MoE expert GEMMs: "auto" = Pallas grouped GEMM on TPU / einsum elsewhere,
     # "pallas" = force (interpret off-TPU), "einsum" = XLA dot path.
     moe_matmul: str = "auto"
+    # MoE token dispatch (ops/moe_dispatch): "sorted" = token-sorted drop-free
+    # gather/scatter (all_to_all over the ep axis when ep > 1), "einsum" =
+    # legacy dense one-hot capacity dispatch (silently drops tokens past
+    # moe_capacity_factor — kept as parity reference and kill switch),
+    # "auto" = LLMD_MOE_DISPATCH env override, else sorted everywhere.
+    moe_dispatch: str = "auto"
     # Weight-only quantization (models/quant.py): "int8" halves decode's
     # HBM weight traffic — per-output-channel symmetric on the dense
     # projections, the unembedding, and the MoE expert banks (per-expert

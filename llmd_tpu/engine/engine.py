@@ -24,6 +24,7 @@ continuous batching on accelerator'), built XLA-first:
 from __future__ import annotations
 
 import functools
+import os
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
@@ -107,6 +108,9 @@ class EngineStats:
     attn_backend: str = ""  # kernel provenance (bench/debug)
     attn_tune_hash: Optional[str] = None  # active block-size tune table (ops/attn_tune)
     moe_backend: str = ""
+    moe_dispatch: str = ""  # "sorted" | "einsum" — routing-dispatch provenance
+    moe_dropped_tokens: int = 0  # routed copies dropped past capacity (einsum
+    # path only; the sorted path is drop-free by construction)
     kv_cache_dtype: str = ""  # "bf16" | "fp8" — pool dtype provenance
     kv_layout: str = ""  # "padded" | "packed-f" — pool lane layout provenance
     sp_attn_backend: Optional[str] = None  # ring layout when sp>1 wired in
@@ -464,9 +468,11 @@ class LLMEngine:
             self.attn_backend += f"+packed{self.kv_pack}"
         attn_decode = select_decode_attn_impl(self, attn)
         moe_impl = self._select_moe_impl()
+        moe_dispatch_impl = self._select_moe_dispatch()
         self.stats.attn_backend = self.attn_backend
         self.stats.attn_tune_hash = self.attn_tune_hash
         self.stats.moe_backend = self.moe_backend
+        self.stats.moe_dispatch = self.moe_dispatch
         # kernel-vs-fallback visibility without scraping logs: an info-style
         # gauge keyed by the resolved backend + tune-table hash (value 1)
         self.metrics.attn_backend_info.labels(
@@ -501,17 +507,18 @@ class LLMEngine:
                 tokens = _bind(tokens, ("dp", "sp"))
                 positions = _bind(positions, ("dp", "sp"))
                 seq_slots = _bind(seq_slots, ("dp", "sp"))
-                hidden, cache, cnt = forward_core(
+                hidden, cache, cnt, drop = forward_core(
                     cfg, params, cache, tokens, positions, seq_slots, page_tables,
                     kv_lens, cu_q_lens=cu_q_lens, num_seqs=num_seqs,
                     attn_impl=attn_fn, moe_matmul_impl=moe_impl,
                     lora_indices=lora_tok if use_lora else None,
                     lora_scale=lora_scale,
                     mm_embeds=mm_embeds, mm_mask=mm_mask,
+                    moe_dispatch_impl=moe_dispatch_impl,
                 )
                 last_rows = jnp.clip(cu_q_lens[1 : B + 1] - 1, 0, NT - 1)  # [B]
                 logits = unembed(cfg, params, hidden[last_rows])  # [B, vocab]
-                return logits, cache, cnt
+                return logits, cache, cnt, drop
 
             return _unified
 
@@ -527,15 +534,16 @@ class LLMEngine:
                 tokens = _bind(tokens, ("dp", "sp"))
                 positions = _bind(positions, ("dp", "sp"))
                 seq_slots = _bind(seq_slots, ("dp", "sp"))
-                hidden, cache, cnt = forward_core(
+                hidden, cache, cnt, drop = forward_core(
                     cfg, params, cache, tokens, positions, seq_slots, page_tables,
                     kv_lens, cu_q_lens=cu_q_lens, num_seqs=num_seqs,
                     attn_impl=attn_fn, moe_matmul_impl=moe_impl,
                     lora_indices=lora_tok if use_lora else None,
                     lora_scale=lora_scale,
+                    moe_dispatch_impl=moe_dispatch_impl,
                 )
                 greedy = greedy_tokens(unembed(cfg, params, hidden))  # [NT]
-                return greedy, cache, cnt
+                return greedy, cache, cnt, drop
 
             return _verify
 
@@ -566,13 +574,14 @@ class LLMEngine:
                 tokens_b = _bind(tokens, ("dp", "sp"))
                 positions_b = _bind(positions, ("dp", "sp"))
                 seq_slots_b = _bind(seq_slots, ("dp", "sp"))
-                hidden, cache, cnt = forward_core(
+                hidden, cache, cnt, drop = forward_core(
                     cfg, params, cache, tokens_b, positions_b, seq_slots_b,
                     page_tables, kv_lens, cu_q_lens=cu_q_lens,
                     num_seqs=num_seqs, attn_impl=attn_fn,
                     moe_matmul_impl=moe_impl,
                     lora_indices=lora_tok if use_lora else None,
                     lora_scale=lora_scale,
+                    moe_dispatch_impl=moe_dispatch_impl,
                 )
                 logits = unembed(cfg, params, hidden).astype(jnp.float32)  # [NT, V]
                 valid = positions >= 0  # padding rows must not touch any state
@@ -596,7 +605,7 @@ class LLMEngine:
                 greedy = jnp.argmax(logits + bias_tab[g_rows, cur_states],
                                     axis=-1).astype(jnp.int32)
                 fsm_next = next_tab[g_rows, cur_states, greedy]  # [NT]
-                return greedy, fsm_next, cache, cnt
+                return greedy, fsm_next, cache, cnt, drop
 
             return _verify_masked
 
@@ -621,12 +630,13 @@ class LLMEngine:
 
             def body(carry, i):
                 cache, toks, pos, lens, key = carry
-                hidden, cache, cnt = forward_core(
+                hidden, cache, cnt, drop = forward_core(
                     cfg, params, cache, toks, pos, seq_slots, page_tables, lens,
                     cu_q_lens=cu, num_seqs=ns, attn_impl=attn_decode,
                     moe_matmul_impl=moe_impl,
                     lora_indices=lora_idx if use_lora else None,
                     lora_scale=lora_scale,
+                    moe_dispatch_impl=moe_dispatch_impl,
                 )
                 logits = unembed(cfg, params, hidden)  # [B, vocab]
                 key, sub = jax.random.split(key)
@@ -635,16 +645,17 @@ class LLMEngine:
                 nxt = jnp.where(act, nxt, 0)
                 pos = jnp.where(act, pos + 1, pos)
                 lens = jnp.where(act, lens + 1, lens)
-                return (cache, nxt, pos, lens, key), (nxt, cnt)
+                return (cache, nxt, pos, lens, key), (nxt, cnt, drop)
 
-            (cache, last_toks, pos_out, lens_out, _), (toks_out, cnts) = jax.lax.scan(
+            (cache, last_toks, pos_out, lens_out, _), (toks_out, cnts, drops) = jax.lax.scan(
                 body, (cache, tokens, positions, kv_lens, key),
                 jnp.arange(k_steps, dtype=jnp.int32),
             )
             # last_toks/pos_out/lens_out: device-resident chain point for the
             # next pipelined call — a chained dispatch reuses them instead of
             # re-packing positions and kv lens on the host
-            return toks_out, last_toks, pos_out, lens_out, cache, cnts.sum(0)
+            return (toks_out, last_toks, pos_out, lens_out, cache, cnts.sum(0),
+                    drops.sum())
 
         def _decode_multi_masked(params, cache, tokens, positions, page_tables,
                                  kv_lens, temp, top_k, top_p, key, steps_left,
@@ -673,12 +684,13 @@ class LLMEngine:
 
             def body(carry, i):
                 cache, toks, pos, lens, key, st = carry
-                hidden, cache, cnt = forward_core(
+                hidden, cache, cnt, drop = forward_core(
                     cfg, params, cache, toks, pos, seq_slots, page_tables, lens,
                     cu_q_lens=cu, num_seqs=ns, attn_impl=attn_decode,
                     moe_matmul_impl=moe_impl,
                     lora_indices=lora_idx if use_lora else None,
                     lora_scale=lora_scale,
+                    moe_dispatch_impl=moe_dispatch_impl,
                 )
                 logits = unembed(cfg, params, hidden).astype(jnp.float32)
                 row_bias = bias_tab[gidx, st]  # [B, vocab]
@@ -691,14 +703,16 @@ class LLMEngine:
                 nxt = jnp.where(act, nxt, 0)
                 pos = jnp.where(act, pos + 1, pos)
                 lens = jnp.where(act, lens + 1, lens)
-                return (cache, nxt, pos, lens, key, st), (nxt, cnt)
+                return (cache, nxt, pos, lens, key, st), (nxt, cnt, drop)
 
-            (cache, last_toks, pos_out, lens_out, _, fsm_out), (toks_out, cnts) = (
+            (cache, last_toks, pos_out, lens_out, _, fsm_out), (toks_out, cnts,
+                                                                drops) = (
                 jax.lax.scan(
                     body, (cache, tokens, positions, kv_lens, key, fsm_state),
                     jnp.arange(k_steps, dtype=jnp.int32),
                 ))
-            return toks_out, last_toks, pos_out, lens_out, fsm_out, cache, cnts.sum(0)
+            return (toks_out, last_toks, pos_out, lens_out, fsm_out, cache,
+                    cnts.sum(0), drops.sum())
 
         def _embed(params, cache, tokens, positions, page_tables, kv_lens,
                    cu_q_lens, lora_idx):
@@ -707,11 +721,12 @@ class LLMEngine:
             tokens = _bind(tokens, ("dp", "sp"))
             positions = _bind(positions, ("dp", "sp"))
             seq_slots = jnp.zeros_like(tokens)
-            hidden, cache, _cnt = forward_core(
+            hidden, cache, _cnt, _drop = forward_core(
                 cfg, params, cache, tokens, positions, seq_slots, page_tables,
                 kv_lens, cu_q_lens=cu_q_lens, num_seqs=jnp.array([1], jnp.int32),
                 attn_impl=attn, moe_matmul_impl=moe_impl,
                 lora_indices=lora_idx if use_lora else None, lora_scale=lora_scale,
+                moe_dispatch_impl=moe_dispatch_impl,
             )
             valid = (positions >= 0).astype(jnp.float32)[:, None]
             return jnp.sum(hidden.astype(jnp.float32) * valid, axis=0), cache
@@ -776,6 +791,42 @@ class LLMEngine:
         self._attn_probe_fn = jax.jit(_attn_probe)
         self._attn_probe_every = 64
         self._attn_probe_warm = False
+
+        # MoE step-phase probe (sorted path only): jitted dispatch / experts /
+        # combine stage calls at the fused-decode token shape, sampled on the
+        # same cadence as the attn probe and observed into
+        # step_duration{phase="moe_dispatch"|"moe_experts"|"moe_combine"}
+        # scaled by layers x k. This is the DBO measurement surface: the
+        # dispatch sample bounds the all-to-all/permute wall a half-batch can
+        # hide behind the other half's expert GEMMs (experts sample), so the
+        # overlap claim is read off the phase ledger instead of asserted.
+        self._moe_probe_fns = None
+        self._moe_probe_warm = False
+        if cfg.is_moe and self.moe_dispatch == "sorted":
+            from llmd_tpu.ops import moe_dispatch as moe_dispatch_ops
+
+            probe_S = (self._eplb_slots if self._eplb is not None
+                       else cfg.moe_num_experts)
+            probe_pallas = self.moe_backend == "pallas_grouped_gemm"
+            probe_bc = moe_dispatch_ops.pick_block_size(
+                B * cfg.moe_top_k, probe_S, probe_pallas)
+
+            def _moe_dispatch_probe(x, idx, topw, valid):
+                return moe_dispatch_ops.dispatch_stage(
+                    x, idx, topw, valid, probe_S, probe_bc)
+
+            def _moe_experts_probe(xs, block_slot, block_rows, wi, wo,
+                                   wi_scale, wo_scale):
+                return moe_dispatch_ops.experts_stage(
+                    xs, block_slot, block_rows, wi, wo, wi_scale, wo_scale,
+                    use_pallas=probe_pallas)
+
+            def _moe_combine_probe(ye, row, tok, wf):
+                return moe_dispatch_ops.combine_stage(ye, row, tok, wf, B)
+
+            self._moe_probe_fns = (jax.jit(_moe_dispatch_probe),
+                                   jax.jit(_moe_experts_probe),
+                                   jax.jit(_moe_combine_probe))
         # SP long-context prefill: a second unified program whose attention is
         # the zig-zag ring over the sp axis (ops/ring_attention.py), engaged
         # host-side for self-contained single-sequence prefill steps only —
@@ -913,6 +964,48 @@ class LLMEngine:
             self.moe_fallback_reason = f"pallas smoke-compile failed: {type(e).__name__}: {e}"
             return None
 
+    def _select_moe_dispatch(self):
+        """Pick the MoE routing-dispatch path (orthogonal to the expert-GEMM
+        backend above): token-sorted drop-free (ops/moe_dispatch) vs the
+        legacy capacity-einsum reference. ``EngineConfig.moe_dispatch`` =
+        auto|sorted|einsum; auto honours LLMD_MOE_DISPATCH and otherwise
+        resolves to sorted everywhere — einsum stays as the parity
+        reference and kill switch. Returns the dispatch_impl closure (or
+        None for einsum); provenance in ``moe_dispatch`` /
+        ``moe_dispatch_fallback_reason``."""
+        self.moe_dispatch_fallback_reason: Optional[str] = None
+        if not self.model_cfg.is_moe:
+            self.moe_dispatch = "n/a (dense model)"
+            return None
+        mode = self.cfg.moe_dispatch
+        if mode == "auto":
+            mode = os.environ.get("LLMD_MOE_DISPATCH", "") or "sorted"
+        if mode not in ("sorted", "einsum"):
+            raise ValueError(
+                f"moe_dispatch must be auto|sorted|einsum, got {mode!r}")
+        if mode == "einsum":
+            self.moe_dispatch = "einsum"
+            return None
+        # slot dim must divide the ep axis for the bucketed all_to_all;
+        # EPLB already rounds its slot count up (_init_eplb), so only the
+        # bare expert count can mismatch
+        ep = max(1, self.cfg.mesh.ep) if self.mesh is not None else 1
+        S = self._eplb_slots if self._eplb is not None \
+            else self.model_cfg.moe_num_experts
+        if S % ep:
+            self.moe_dispatch = "einsum"
+            self.moe_dispatch_fallback_reason = (
+                f"expert slots ({S}) do not divide the ep axis ({ep})")
+            return None
+        from llmd_tpu.ops.moe_dispatch import make_sorted_dispatch
+
+        # expert GEMMs ride the ragged Pallas kernel exactly when the
+        # einsum path would have used the grouped Pallas kernel (bf16 on
+        # TPU); CPU and int8 banks use the gathered-einsum block backend
+        use_pallas = self.moe_backend == "pallas_grouped_gemm"
+        self.moe_dispatch = "sorted"
+        return make_sorted_dispatch(self.mesh, use_pallas=use_pallas)
+
     # ----------------------------------------------------------------- EPLB
     # Wide-EP expert load balancing (reference --enable-eplb, wide-ep
     # decode.yaml:114-118). Physical slot weights + replica tables live beside the
@@ -965,10 +1058,25 @@ class LLMEngine:
         self._eplb_rebalance()
 
     def _eplb_rebalance(self) -> None:
-        from llmd_tpu.parallel.eplb import rebalance
+        from llmd_tpu.parallel.eplb import balance_ratio, rebalance
 
         ep = max(1, self.cfg.mesh.ep)
-        s2e, slots, counts = rebalance(self._eplb_tracker.loads(), self._eplb_slots, ep)
+        loads = self._eplb_tracker.loads()
+        # imbalance under the OUTGOING placement (what serving just ran with):
+        # max/mean routed tokens per EP rank, averaged over layers — the
+        # "before" half of the rebalance-effectiveness pair on /metrics
+        if getattr(self, "_eplb_s2e", None) is not None:
+            self.metrics.moe_ep_imbalance.labels(when="before").set(
+                float(np.mean([
+                    balance_ratio(loads[l], self._eplb_s2e[l],
+                                  self._eplb_counts[l], ep)
+                    for l in range(loads.shape[0])])))
+        s2e, slots, counts = rebalance(loads, self._eplb_slots, ep)
+        self.metrics.moe_ep_imbalance.labels(when="after").set(
+            float(np.mean([
+                balance_ratio(loads[l], s2e[l], counts[l], ep)
+                for l in range(loads.shape[0])])))
+        self._eplb_counts = counts
         L, E, R = slots.shape
         if R < self._eplb_rmax:  # pad replica dim to its fixed max (no recompiles)
             pad = np.repeat(slots[:, :, :1], self._eplb_rmax - R, axis=2)
@@ -1087,6 +1195,20 @@ class LLMEngine:
     def _eplb_record(self, cnt: jax.Array) -> None:
         self._eplb_tracker.record(np.asarray(cnt))
         self._eplb_active = True
+
+    def _moe_record_dropped(self, drop) -> None:
+        """Surface the silent-capacity-drop bug: every routed copy the legacy
+        einsum path dropped past capacity C counts here (the sorted path
+        returns a structural 0 — moe_check asserts the scrape stays 0).
+        Called where the step's outputs are already host-synced (or one call
+        behind on the pipelined decode path), so the scalar read adds no
+        device sync of its own."""
+        if not self.model_cfg.is_moe:
+            return
+        n = int(np.asarray(drop))
+        self.stats.moe_dropped_tokens += n
+        self.metrics.moe_dropped_tokens.labels(
+            path=self.stats.moe_dispatch or "einsum").inc(n)
 
     def _eplb_tick(self) -> None:
         # Count only steps that routed tokens — idle wave steps (DP lockstep with
@@ -1715,7 +1837,7 @@ class LLMEngine:
         # same step, so dispatch and completion are recorded together
         self.programs.record_dispatch(step_prog)
         self.programs.record_complete(step_prog)
-        logits, self.cache, cnt = step_fn(
+        logits, self.cache, cnt, moe_drop = step_fn(
             self._run_params(), self.cache, jnp.asarray(toks), jnp.asarray(pos),
             jnp.asarray(sids), jnp.asarray(pts), jnp.asarray(lens), jnp.asarray(cu),
             jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok), *mm_args,
@@ -1726,6 +1848,8 @@ class LLMEngine:
         t2 = time.perf_counter()
         if self._eplb is not None:
             self._eplb_record(cnt)
+        if self.model_cfg.is_moe:
+            self._moe_record_dropped(moe_drop)
 
         # goodput classification reads pre-postprocess sequence state: the
         # first-chunk prefix credit (num_computed == num_cached_prompt only
@@ -2122,14 +2246,14 @@ class LLMEngine:
         self.programs.record_dispatch(prog)
         if mask is None:
             fsm_out = None
-            greedy, self.cache, cnt = self._verify_fn(
+            greedy, self.cache, cnt, moe_drop = self._verify_fn(
                 self._run_params(), self.cache, jnp.asarray(toks),
                 jnp.asarray(pos), jnp.asarray(sids), jnp.asarray(pts),
                 jnp.asarray(lens), jnp.asarray(cu),
                 jnp.asarray([len(plan)], jnp.int32), jnp.asarray(lora_tok),
             )
         else:
-            greedy, fsm_out, self.cache, cnt = self._verify_masked_fn(
+            greedy, fsm_out, self.cache, cnt, moe_drop = self._verify_masked_fn(
                 self._run_params(), self.cache, jnp.asarray(toks),
                 jnp.asarray(pos), jnp.asarray(sids), jnp.asarray(pts),
                 jnp.asarray(lens), jnp.asarray(cu),
@@ -2144,6 +2268,8 @@ class LLMEngine:
         t2 = time.perf_counter()
         if self._eplb is not None:
             self._eplb_record(cnt)
+        if self.model_cfg.is_moe:
+            self._moe_record_dropped(moe_drop)
         now = time.monotonic()
         spec_rej0 = self.stats.spec_rejected
         n_tokens = 0
@@ -2573,13 +2699,14 @@ class LLMEngine:
                 t1 - wall_start)
         if mask is not None:
             (toks_out, last_toks, pos_out, lens_out, fsm_out, self.cache,
-             cnt) = self._decode_multi_masked_fn(
+             cnt, moe_drop) = self._decode_multi_masked_fn(
                 self._run_params(), self.cache, toks_in, pos_in, pts_dev,
                 lens_in, temp_dev, tk_dev, tp_dev, sub, steps_dev, lora_dev,
                 fsm_in, mask["gidx"], mask["bias_tab"], mask["next_tab"],
             )
         else:
-            toks_out, last_toks, pos_out, lens_out, self.cache, cnt = (
+            (toks_out, last_toks, pos_out, lens_out, self.cache, cnt,
+             moe_drop) = (
                 self._decode_multi_fn(
                     self._run_params(), self.cache, toks_in, pos_in, pts_dev,
                     lens_in, temp_dev, tk_dev, tp_dev, sub, steps_dev,
@@ -2601,12 +2728,20 @@ class LLMEngine:
         if (self._attn_probe_fn is not None
                 and self.stats.n_decode_dispatches % self._attn_probe_every == 0):
             self._observe_attn_phase(pts_np, lens_np, k)
+        if (self._moe_probe_fns is not None
+                and self.stats.n_decode_dispatches % self._attn_probe_every == 0):
+            self._observe_moe_phase(k)
         # Start the device->host copy of everything _decode_process will read.
         # Remote/tunneled runtimes defer execution until a result is demanded;
         # the async-copy hint makes the call run (and its tokens land on the
         # host) while the host loop does other work, so the later np.asarray
         # is a near-free read instead of RTT + compute.
-        for arr in (toks_out,) if self._eplb is None else (toks_out, cnt):
+        host_reads = [toks_out]
+        if self._eplb is not None:
+            host_reads.append(cnt)
+        if self.model_cfg.is_moe:
+            host_reads.append(moe_drop)
+        for arr in host_reads:
             try:
                 arr.copy_to_host_async()
             except (AttributeError, RuntimeError):
@@ -2627,6 +2762,7 @@ class LLMEngine:
             "util_cost": util_cost,
             "rows": [(s, s.slot) for s in active], "prog": prog,
             "toks_out": toks_out, "last_toks": last_toks, "cnt": cnt, "k": k,
+            "moe_drop": moe_drop,
             # device-resident chain point for the next pipelined dispatch
             "pos_out": pos_out, "lens_out": lens_out, "fsm_out": fsm_out,
             "mask": mask, "pts_np": pts_np, "pts_dev": pts_dev,
@@ -2654,6 +2790,59 @@ class LLMEngine:
         except Exception:  # noqa: BLE001 — observability must not take down serving
             self._attn_probe_fn = None
 
+    def _observe_moe_phase(self, k: int) -> None:
+        """Sampled MoE stage probe (sorted dispatch only): time the jitted
+        dispatch / experts / combine stage calls at the fused-decode token
+        shape against the live expert bank, observe each wall x layers x k
+        into its step_duration phase. Synthetic uniform routing — the probe
+        measures the stage mechanics (sort/scatter, grouped GEMM, inverse
+        permute), not this step's skew; EPLB load stats come from the real
+        counts. First call compiles and is discarded; failure disables the
+        probe, never serving."""
+        try:
+            p = self._run_params()
+            if "moe_wi_q" in p:
+                wi, wo = p["moe_wi_q"][0], p["moe_wo_q"][0]
+                wi_s, wo_s = p["moe_wi_scale"][0], p["moe_wo_scale"][0]
+            else:
+                wi, wo = p["moe_wi"][0], p["moe_wo"][0]
+                wi_s = wo_s = None
+            cfg = self.model_cfg
+            B = self.cfg.max_batch_size
+            kk = cfg.moe_top_k
+            S = wi.shape[0]
+            x = jnp.zeros((B, cfg.hidden_size), cfg.jax_dtype)
+            idx = (jnp.arange(B * kk, dtype=jnp.int32) % S).reshape(B, kk)
+            topw = jnp.full((B, kk), 1.0 / kk, cfg.jax_dtype)
+            valid = jnp.ones((B, 1), jnp.int32)
+            fd, fe, fc = self._moe_probe_fns
+            if not self._moe_probe_warm:
+                staged = fd(x, idx, topw, valid)
+                ye = fe(staged[0], staged[4], staged[5], wi, wo, wi_s, wo_s)
+                fc(ye, staged[1], staged[2], staged[3]).block_until_ready()
+                self._moe_probe_warm = True
+            scale = cfg.num_layers * k
+            with jax.profiler.TraceAnnotation("llmd.moe_dispatch_probe"):
+                t0 = time.perf_counter()
+                staged = fd(x, idx, topw, valid)
+                jax.block_until_ready(staged)
+                self.metrics.step_duration.labels(phase="moe_dispatch").observe(
+                    (time.perf_counter() - t0) * scale)
+            xs, row, tok, wf, block_slot, block_rows = staged
+            with jax.profiler.TraceAnnotation("llmd.moe_experts_probe"):
+                t0 = time.perf_counter()
+                ye = fe(xs, block_slot, block_rows, wi, wo, wi_s, wo_s)
+                ye.block_until_ready()
+                self.metrics.step_duration.labels(phase="moe_experts").observe(
+                    (time.perf_counter() - t0) * scale)
+            with jax.profiler.TraceAnnotation("llmd.moe_combine_probe"):
+                t0 = time.perf_counter()
+                fc(ye, row, tok, wf).block_until_ready()
+                self.metrics.step_duration.labels(phase="moe_combine").observe(
+                    (time.perf_counter() - t0) * scale)
+        except Exception:  # noqa: BLE001 — observability must not take down serving
+            self._moe_probe_fns = None
+
     @_profile_phase("llmd.decode_process")
     def _decode_process(self, rec: dict) -> None:
         """Read one in-flight decode call's results and apply them to host state."""
@@ -2664,6 +2853,10 @@ class LLMEngine:
             self._eplb_record(rec["cnt"])
         # llmd-lint: allow[hot-host-sync] designed sync point: the one deferred readback per decode step (dispatch/process split hides it behind the next dispatch)
         toks_out = np.asarray(rec["toks_out"])  # [k, B] (device sync point)
+        if self.model_cfg.is_moe:
+            # the async copy was started at dispatch; toks_out above already
+            # paid this step's sync, so the drop scalar read is free
+            self._moe_record_dropped(rec["moe_drop"])
         t2 = time.perf_counter()
         now = time.monotonic()
         for s, slot in rec["rows"]:

@@ -61,6 +61,14 @@ def main() -> None:
                     default=os.environ.get("LLMD_ATTN_TUNE_FILE"),
                     help="shape-keyed attention block-size table "
                          "(ops/attn_tune JSON, written by bench.py's tuner)")
+    ap.add_argument("--moe-dispatch",
+                    default=os.environ.get("LLMD_MOE_DISPATCH", "") or "auto",
+                    choices=["auto", "sorted", "einsum"],
+                    help="MoE token dispatch (EngineConfig.moe_dispatch): "
+                         "sorted = token-sorted drop-free path "
+                         "(ops/moe_dispatch, all_to_all over ep), einsum = "
+                         "legacy capacity dispatch (kill switch; drops past "
+                         "capacity); auto = sorted")
     ap.add_argument("--cpu-offload-pages", type=int, default=0,
                     help="KV blocks of CPU offload tier (TPU_OFFLOAD_NUM_CPU_CHUNKS)")
     ap.add_argument("--offload-fs-path", default=None,
@@ -177,6 +185,7 @@ def main() -> None:
         kv_layout=args.kv_layout,
         attn_impl=args.attn_impl,
         attn_tune_file=args.attn_tune_file,
+        moe_dispatch=args.moe_dispatch,
         spec_mode=args.spec_mode, spec_tokens=args.spec_tokens,
         spec_ngram_max=args.spec_ngram_max, spec_ngram_min=args.spec_ngram_min,
         structured_mode=args.structured_mode,

@@ -238,8 +238,20 @@ def moe_block(
     token_mask: Optional[jax.Array] = None,
     wi_scale: Optional[jax.Array] = None,
     wo_scale: Optional[jax.Array] = None,
-) -> tuple[jax.Array, jax.Array]:
+    dispatch_impl=None,
+    return_dropped: bool = False,
+):
     """Top-k routed MoE with capacity-based dispatch (XLA-friendly static shapes).
+
+    ``dispatch_impl(x, idx, topw, valid, wi, wo, wi_scale, wo_scale) -> y``
+    replaces the capacity einsums below with the token-sorted drop-free path
+    (ops/moe_dispatch; ``EngineConfig.moe_dispatch``). Routing — softmax,
+    top-k, renorm, EPLB replica choice — stays HERE either way, so both
+    paths see identical routing decisions and the einsum path remains a
+    bit-for-bit parity reference. ``return_dropped`` appends a scalar int32
+    count of routed-but-dropped copies (always 0 on the sorted path; the
+    legacy path drops past capacity C) for the
+    ``llmd_tpu:moe_dropped_tokens_total`` surface.
 
     x: [T, D]. Expert dim is sharded over the `ep` mesh axis; the dispatch/combine
     einsums lower to all-to-all when tokens are dp/sp-sharded — the XLA-native stand-in
@@ -281,13 +293,23 @@ def moe_block(
     else:
         S, idx = E, topi
 
-    def half(x, idx, topw, valid):
+    if dispatch_impl is not None:
+        def half(x, idx, topw, valid):
+            y = dispatch_impl(x, idx, topw, valid, wi, wo, wi_scale, wo_scale)
+            return y, jnp.zeros((), jnp.int32)  # drop-free by construction
+    else:
+        half = None
+
+    def half_einsum(x, idx, topw, valid):
         t = x.shape[0]
+        # moe_capacity_factor is a legacy-path-only knob: the sorted path
+        # has no capacity C to overflow
         C = max(1, int(t * k / S * cfg.moe_capacity_factor))
         onehot = jax.nn.one_hot(idx, S, dtype=jnp.int32) * valid[..., None]  # [t, k, S]
         flat = onehot.reshape(t * k, S)
         pos_in_expert = (jnp.cumsum(flat, axis=0) - flat).reshape(t, k, S)
-        keep = (pos_in_expert < C).astype(x.dtype) * onehot.astype(x.dtype)
+        keep_i = (pos_in_expert < C).astype(jnp.int32) * onehot  # exact count
+        keep = keep_i.astype(x.dtype)
         disp = keep[..., None] * jax.nn.one_hot(pos_in_expert, C, dtype=x.dtype)
         comb = disp * topw[..., None, None].astype(x.dtype)
         disp2 = disp.sum(1)  # [t, S, C]
@@ -310,17 +332,27 @@ def moe_block(
                             wo.astype(x.dtype))
             if wo_scale is not None:
                 ye = ye * wo_scale[:, None, :].astype(x.dtype)
-        return jnp.einsum("tec,ecd->td", comb2, ye)  # all-to-all back
+        y = jnp.einsum("tec,ecd->td", comb2, ye)  # all-to-all back
+        kept = jnp.sum(keep_i)  # routed copies that got a capacity slot
+        return y, kept
+
+    if half is None:
+        half = half_einsum
 
     if cfg.moe_dbo and T % 2 == 0 and T >= 2:
         h = T // 2
-        y = jnp.concatenate([
-            half(x[:h], idx[:h], topw[:h], valid[:h]),
-            half(x[h:], idx[h:], topw[h:], valid[h:]),
-        ])
+        ya, ka = half(x[:h], idx[:h], topw[:h], valid[:h])
+        yb, kb = half(x[h:], idx[h:], topw[h:], valid[h:])
+        y, kept = jnp.concatenate([ya, yb]), ka + kb
     else:
-        y = half(x, idx, topw, valid)
-    return y, counts
+        y, kept = half(x, idx, topw, valid)
+    if not return_dropped:
+        return y, counts
+    if dispatch_impl is not None:
+        dropped = jnp.zeros((), jnp.int32)
+    else:
+        dropped = jnp.sum(counts) - kept  # routed minus kept == capacity drops
+    return y, counts, dropped
 
 
 # ---------------------------------------------------------------------------
@@ -488,14 +520,21 @@ def forward_core(
     lora_scale: float = 1.0,
     mm_embeds: Optional[jax.Array] = None,  # [N, D] encode-stage rows, row-aligned
     mm_mask: Optional[jax.Array] = None,  # [N] True where tokens[i] is a placeholder
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    moe_dispatch_impl=None,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Run a flat mixed batch through the model, writing K/V into the paged cache.
 
     Serves batched/chunked prefill and decode in ONE program: the engine packs
     whatever fits its token budget. Returns (hidden [N, D] final-normed, updated
-    cache, expert_counts [L, E]). Callers unembed whichever rows they need (the
+    cache, expert_counts [L, E], moe_dropped scalar int32 — routed copies the
+    legacy capacity path dropped this step, 0 on the sorted path and for dense
+    models). Callers unembed whichever rows they need (the
     engine only unembeds each sequence's last row — prefill never pays the full
     [N, vocab] logits matmul).
+
+    ``moe_dispatch_impl`` selects the token-sorted drop-free dispatch
+    (ops/moe_dispatch.make_sorted_dispatch); None keeps the capacity-einsum
+    legacy path.
 
     EPLB mode: when ``params`` carries ``eplb_replica_slots``/``eplb_replica_counts``
     (engine-injected, see engine's rebalance path), ``moe_wi``/``moe_wo`` are physical
@@ -663,7 +702,7 @@ def forward_core(
                 else None
             )
             quant_moe = "moe_wi_q" in lp  # int8 expert banks: einsum path only
-            y, cnt = moe_block(
+            y, cnt, drop = moe_block(
                 cfg, h, lp["router"],
                 lp["moe_wi_q" if quant_moe else "moe_wi"],
                 lp["moe_wo_q" if quant_moe else "moe_wo"],
@@ -672,6 +711,8 @@ def forward_core(
                 token_mask=(positions >= 0),
                 wi_scale=lp["moe_wi_scale"] if quant_moe else None,
                 wo_scale=lp["moe_wo_scale"] if quant_moe else None,
+                dispatch_impl=moe_dispatch_impl,
+                return_dropped=True,
             )
             if cfg.moe_num_shared_experts:
                 if "shared_wi_q" in lp:
@@ -684,19 +725,20 @@ def forward_core(
                     y = y + swiglu(h, lp["shared_wi"], lp["shared_wo"])
         else:
             cnt = jnp.zeros((0,), jnp.int32)
+            drop = jnp.zeros((), jnp.int32)
             y = swiglu(h, None, None, mm=_mm) if "wi_q" in lp else swiglu(
                 h, lp["wi"], lp["wo_mlp"])
         x = x + y
-        return (x, flat_cache), cnt
+        return (x, flat_cache), (cnt, drop)
 
-    (x, flat_cache), expert_counts = lax.scan(
+    (x, flat_cache), (expert_counts, dropped) = lax.scan(
         body,
         (x, cache.reshape(Ptot * ps, HkC, Dhp)),
         (layer_params, jnp.arange(cfg.num_layers, dtype=jnp.int32)),
         unroll=layer_unroll(cfg.num_layers),
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_eps)
-    return x, flat_cache.reshape(Ptot, ps, HkC, Dhp), expert_counts
+    return x, flat_cache.reshape(Ptot, ps, HkC, Dhp), expert_counts, dropped.sum()
 
 
 def unembed(cfg: ModelConfig, params: dict[str, jax.Array], hidden: jax.Array) -> jax.Array:
@@ -721,6 +763,7 @@ def forward(
     lora_indices: Optional[jax.Array] = None,  # [B] adapter slot per row (0 = none)
     lora_scale: float = 1.0,
     with_hidden: bool = False,
+    moe_dispatch_impl=None,
 ) -> tuple[jax.Array, ...]:
     """[B, T]-shaped convenience wrapper over ``forward_core`` (tests, entrypoints).
 
@@ -732,10 +775,11 @@ def forward(
     B, T = tokens.shape
     seq_slots = jnp.repeat(jnp.arange(B, dtype=jnp.int32), T)
     lora_tok = jnp.repeat(lora_indices, T) if lora_indices is not None else None
-    hidden, new_cache, counts = forward_core(
+    hidden, new_cache, counts, _dropped = forward_core(
         cfg, params, cache, tokens.reshape(-1), positions.reshape(-1), seq_slots,
         page_tables, kv_lens, attn_impl=None, moe_matmul_impl=moe_matmul_impl,
         lora_indices=lora_tok, lora_scale=lora_scale,
+        moe_dispatch_impl=moe_dispatch_impl,
     )
     logits = unembed(cfg, params, hidden).reshape(B, T, -1)
     if with_hidden:

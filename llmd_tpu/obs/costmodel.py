@@ -197,6 +197,19 @@ def decode_hbm_gb_per_token(cfg, quantize_weights: Optional[str],
             / max(1, max_batch_size))
 
 
+def moe_comm_bytes_per_token(cfg) -> int:
+    """MoE dispatch/combine traffic per slot token: every layer ships each
+    of the top-k routed copies of the D-wide activation to its expert and
+    back (2 hops — DeepEP's dispatch + combine, lax.all_to_all here). Dense
+    models route nothing. Counted in ``dispatch_cost`` so ``program_mbu``
+    sees the all-to-all bytes the roofline previously ignored."""
+    if not getattr(cfg, "is_moe", False):
+        return 0
+    act_bytes = 2 if cfg.dtype == "bfloat16" else 4
+    return (cfg.num_layers * cfg.moe_top_k * cfg.hidden_size
+            * act_bytes * 2)
+
+
 @dataclass(frozen=True)
 class DispatchCost:
     """Analytic cost of ONE compiled-program dispatch, from its packed shape.
@@ -210,6 +223,10 @@ class DispatchCost:
     flops: float
     hbm_bytes: float
     slot_tokens: int
+    # MoE all-to-all dispatch+combine traffic (slot_tokens x k x D x bytes x
+    # 2 hops x layers); already folded into hbm_bytes, kept separate so the
+    # bench JSON / ledger can report the comm share on its own.
+    moe_comm_bytes: float = 0.0
 
 
 def dispatch_cost(cfg, *, slot_tokens: int, weight_passes: int = 1,
@@ -217,15 +234,18 @@ def dispatch_cost(cfg, *, slot_tokens: int, weight_passes: int = 1,
                   quantize_weights: Optional[str] = None,
                   kv_cache_dtype: Optional[str] = None) -> DispatchCost:
     """Cost of one dispatch: ``2 * active_params`` FLOPs per slot token;
-    bytes = weight passes + KV page reads/writes. Monotone in every token
-    argument (test-asserted)."""
+    bytes = weight passes + KV page reads/writes + MoE dispatch/combine
+    comm. Monotone in every token argument (test-asserted)."""
     kvb = kv_bytes_per_token(cfg, kv_cache_dtype)
+    moe_comm = float(moe_comm_bytes_per_token(cfg)) * max(0, slot_tokens)
     return DispatchCost(
         flops=flops_per_token(cfg) * max(0, slot_tokens),
         hbm_bytes=(float(weight_bytes(cfg, quantize_weights)) * weight_passes
                    + float(kvb) * (max(0, kv_read_tokens)
-                                   + max(0, kv_write_tokens))),
+                                   + max(0, kv_write_tokens))
+                   + moe_comm),
         slot_tokens=max(0, slot_tokens),
+        moe_comm_bytes=moe_comm,
     )
 
 
@@ -310,11 +330,12 @@ class UtilLedger:
             tk["padding"] += padding
             tk["preempted_recompute"] += preempted_recompute
             tk["prefix_saved"] += prefix_saved
-            c = self._cost.setdefault(program, [0.0, 0.0, 0.0, 0])
+            c = self._cost.setdefault(program, [0.0, 0.0, 0.0, 0, 0.0])
             c[0] += cost.flops
             c[1] += cost.hbm_bytes
             c[2] += max(0.0, duration_s)
             c[3] += 1
+            c[4] += cost.moe_comm_bytes
             ev = self._events.setdefault(
                 program, collections.deque())
             ev.append((t, cost.flops, cost.hbm_bytes))
@@ -421,6 +442,13 @@ class UtilLedger:
             return None
         _, b = self.achieved(program)
         return None if b is None else b / self.peak_bytes
+
+    def moe_comm_total(self) -> float:
+        """Cumulative MoE all-to-all bytes across all programs — the bench
+        JSON ``moe_comm_bytes`` key reads this, so the offline number and
+        the hbm_bytes fold that feeds program_mbu share one accumulator."""
+        with self._lock:
+            return sum(c[4] for c in self._cost.values())
 
     def compiles(self) -> Dict[str, int]:
         with self._lock:

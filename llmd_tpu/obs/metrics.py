@@ -464,7 +464,9 @@ class EngineMetrics:
             "fast-path pack hidden behind the in-flight device call, "
             "chain_stage = dense grammar/bias table staging per chain; attn = "
             "sampled attention-only probe scaled to the fused call: "
-            "wall x layers x k)",
+            "wall x layers x k; moe_dispatch / moe_experts / moe_combine = "
+            "sampled MoE stage probes scaled the same way — the measured DBO "
+            "overlap evidence)",
             labelnames=("phase",))
         self.attn_backend_info = reg.gauge(
             "llmd_tpu:engine_attn_backend",
@@ -681,6 +683,23 @@ class EngineMetrics:
             "step, so the step wall approximates compile time)",
             labelnames=("program",),
             buckets=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
+        # MoE dispatch health (ops/moe_dispatch): the legacy einsum path
+        # silently drops tokens past moe_capacity_factor — this counter
+        # surfaces the quality bug the sorted path eliminates (sorted is
+        # drop-free by construction, so path="sorted" staying 0 is the
+        # standing invariant; path="einsum" counts routed - kept).
+        self.moe_dropped_tokens = reg.counter(
+            "llmd_tpu:moe_dropped_tokens_total",
+            "Routed MoE tokens dropped at expert capacity, by dispatch path "
+            "(sorted is drop-free by construction — a non-zero sorted series "
+            "is a dispatch bug; einsum counts routed - kept per step)",
+            labelnames=("path",))
+        self.moe_ep_imbalance = reg.gauge(
+            "llmd_tpu:moe_ep_load_imbalance",
+            "Per-EP-rank expert-load imbalance (max/mean routed tokens per "
+            "rank over the EPLB window), stamped before and after each "
+            "rebalance (when=before|after; 1.0 = perfectly balanced)",
+            labelnames=("when",))
 
 
 class EngineServerMetrics:

@@ -78,6 +78,64 @@ def grouped_gemm(
     return out[:, :C, :F]
 
 
+def _rgg_kernel(slots_ref, rows_ref, x_ref, w_ref, o_ref):
+    b = pl.program_id(0)
+
+    @pl.when(rows_ref[b] > 0)
+    def _compute():
+        acc = jax.lax.dot_general(
+            x_ref[0], w_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        o_ref[0] = acc.astype(o_ref.dtype)
+
+    @pl.when(rows_ref[b] == 0)
+    def _skip():
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ragged_grouped_gemm(
+    x: jax.Array,  # [nb, bc, D] — token-sorted block-aligned activations
+    w: jax.Array,  # [S, D, F] — expert slot bank
+    block_slot: jax.Array,  # [nb] int32 — expert slot owning each block
+    block_rows: jax.Array,  # [nb] int32 — real rows in each block
+    interpret: bool | None = None,
+) -> jax.Array:  # [nb, bc, F]
+    """Block-ragged grouped GEMM for the token-sorted dispatch path
+    (ops/moe_dispatch): each [bc, D] block multiplies the weight of the
+    slot it belongs to — the slot id rides in scalar prefetch so the
+    weight DMA is indexed per block, and fully-padded blocks skip their
+    MXU work just like zero-count groups in ``grouped_gemm``."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb, bc, D = x.shape
+    _, _, F = w.shape
+
+    bf = min(256, 128 * ((F + 127) // 128))
+    Fp = -(-F // bf) * bf
+    if Fp != F:
+        w = jnp.pad(w, ((0, 0), (0, 0), (0, Fp - F)))
+
+    out = pl.pallas_call(
+        _rgg_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(nb, Fp // bf),
+            in_specs=[
+                pl.BlockSpec((1, bc, D), lambda b, j, slots, rows: (b, 0, 0)),
+                pl.BlockSpec((1, D, bf),
+                             lambda b, j, slots, rows: (slots[b], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((1, bc, bf),
+                                   lambda b, j, slots, rows: (b, 0, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((nb, bc, Fp), x.dtype),
+        interpret=interpret,
+    )(block_slot.astype(jnp.int32), block_rows.astype(jnp.int32), x, w)
+    return out[:, :, :F]
+
+
 def make_moe_matmul(interpret: bool | None = None):
     """Adapter with the ``moe_block`` matmul_impl signature."""
     def impl(xe, we, slot_counts):

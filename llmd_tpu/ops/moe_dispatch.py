@@ -1,0 +1,322 @@
+"""Token-sorted, drop-free MoE dispatch — the TPU answer to DeepEP's
+low-latency all-to-all (SURVEY §2.4/§3.3, wide-ep decode.yaml
+`--enable-dbo` / NVSHMEM buffers; ROADMAP item 1).
+
+The legacy path in ``models.transformer.moe_block`` materialises dense
+one-hot dispatch/combine tensors of shape ``[T, S, C]`` and pays
+O(T·S·C·D) in the two routing einsums — at decode shapes that dwarfs the
+expert GEMMs themselves, and any token routed past capacity ``C`` is
+silently dropped. This module replaces it:
+
+* argsort the flat ``(token, k)`` assignments by physical slot id
+  (EPLB's replica choice already happened upstream, so the sort key IS
+  the load-balanced placement),
+* scatter activations into a block-aligned buffer whose per-slot
+  segments start at multiples of the GEMM block size ``bc`` — static
+  shapes, data-dependent fill, zero drops,
+* run experts as a ragged grouped GEMM over the blocks (Pallas on TPU,
+  gathered batched einsum on CPU/int8),
+* combine by the inverse permutation, weighted by router probs.
+
+Single device / ``ep == 1``: pure gather/scatter by sorted index, no
+collective. ``ep > 1``: bounded per-rank buckets exchanged with
+``lax.all_to_all`` inside ``shard_map`` — each EP rank owns a static
+``1/ep`` slice of the token range, sends every routed copy to the rank
+owning its slot (capacity = all of a rank's copies, so nothing can
+drop), computes local experts token-sorted, and returns results over the
+same buckets. ``jax.lax.ragged_all_to_all`` (jax >= 0.5) is
+feature-detected and deliberately not required: the pinned jax 0.4.37
+predates it, so the bounded-bucket exchange is the portable layout.
+
+DBO: callers split the batch in half and invoke this path per half; the
+two halves share no intermediate values, so half A's all-to-all is
+data-independent of half B's expert GEMMs and XLA's scheduler may
+overlap them. Each stage runs under a ``jax.named_scope`` (visible in
+profiles) and is exported standalone so the engine's sampled phase probe
+can time dispatch/experts/combine separately.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .grouped_gemm import ragged_grouped_gemm
+
+
+def has_ragged_all_to_all() -> bool:
+    """Newer jax ships a dedicated ragged collective; the pinned 0.4.37
+    does not — the bounded-bucket ``all_to_all`` below is the fallback."""
+    return hasattr(jax.lax, "ragged_all_to_all")
+
+
+def pick_block_size(tokens_k: int, slots: int, pallas: bool) -> int:
+    """GEMM block rows: about one slot's expected share, power of two.
+
+    The padded buffer is ``Tk + S*bc`` rows, so small ``bc`` keeps the
+    drop-free layout near-dense at decode shapes (Tk ~ S) while prefill
+    (Tk >> S) gets MXU-sized blocks. Pallas tiles need >= 8 sublanes.
+    """
+    bc = 1
+    while bc * slots < tokens_k and bc < 128:
+        bc *= 2
+    return max(8, bc) if pallas else bc
+
+
+def _row_plan(slot: jax.Array, S: int, bc: int):
+    """Static-shape placement of N routed copies into a block-aligned
+    buffer. ``slot`` is [N] int32 in [0, S]; S is the padding sentinel.
+
+    Returns (row [N], block_slot [nb], block_rows [nb], Tp): ``row[i]``
+    is entry i's row in the padded buffer (== Tp for sentinels, which a
+    mode="drop" scatter discards); block b holds rows of expert slot
+    ``block_slot[b]`` with ``block_rows[b]`` of them real.
+    """
+    N = slot.shape[0]
+    order = jnp.argsort(slot, stable=True)
+    ss = slot[order]
+    cnt = jnp.zeros((S + 1,), jnp.int32).at[slot].add(1)[:S]
+    cnt_pad = ((cnt + bc - 1) // bc) * bc
+    starts = jnp.cumsum(cnt) - cnt            # raw sorted-order starts
+    starts_pad = jnp.cumsum(cnt_pad) - cnt_pad  # block-aligned starts
+    Tp = ((N + bc - 1) // bc + S) * bc        # worst-case padding, static
+    sc = jnp.minimum(ss, S - 1)
+    pos_in_slot = jnp.arange(N, dtype=jnp.int32) - starts[sc]
+    row_sorted = jnp.where(ss < S, starts_pad[sc] + pos_in_slot, Tp)
+    row = jnp.zeros((N,), jnp.int32).at[order].set(row_sorted)
+    nb = Tp // bc
+    bstart = jnp.arange(nb, dtype=jnp.int32) * bc
+    # segments are bc-aligned, so each block belongs to exactly one slot:
+    # the last one whose padded start is <= the block start
+    block_slot = jnp.clip(
+        jnp.searchsorted(starts_pad, bstart, side="right").astype(jnp.int32) - 1,
+        0, S - 1)
+    block_rows = jnp.clip(starts_pad[block_slot] + cnt[block_slot] - bstart,
+                          0, bc)
+    return row, block_slot, block_rows, Tp
+
+
+def _experts_xla(xb, block_slot, block_rows, wi, wo, wi_scale, wo_scale):
+    """Gathered batched-einsum expert MLP over [nb, bc, D] blocks — the
+    CPU / int8 backend. Dead rows are zero in ``xb`` and silu(0)*0 == 0,
+    so no masking is needed; per-slot int8 scales gather with the bank."""
+    dt = xb.dtype
+    gate_up = jnp.einsum("bcd,bdf->bcf", xb, wi[block_slot].astype(dt))
+    if wi_scale is not None:
+        gate_up = gate_up * wi_scale[block_slot][:, None, :].astype(dt)
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    ye = jnp.einsum("bcf,bfd->bcd", jax.nn.silu(gate) * up,
+                    wo[block_slot].astype(dt))
+    if wo_scale is not None:
+        ye = ye * wo_scale[block_slot][:, None, :].astype(dt)
+    return ye
+
+
+def _experts_pallas(xb, block_slot, block_rows, wi, wo, wi_scale, wo_scale,
+                    interpret):
+    """Pallas ragged grouped GEMM backend (bf16 banks; int8 stays on the
+    XLA path, mirroring the engine's einsum-path policy)."""
+    gate_up = ragged_grouped_gemm(xb, wi, block_slot, block_rows,
+                                  interpret=interpret)
+    gate, up = jnp.split(gate_up, 2, axis=-1)
+    ye = ragged_grouped_gemm(jax.nn.silu(gate) * up, wo, block_slot,
+                             block_rows, interpret=interpret)
+    return ye
+
+
+# --------------------------------------------------------------------------
+# Stage functions (standalone so the engine phase probe can time each)
+# --------------------------------------------------------------------------
+
+
+def dispatch_stage(x, idx, topw, valid, S: int, bc: int):
+    """Sort + scatter: flat (token, k) copies into the block buffer."""
+    T, D = x.shape
+    k = idx.shape[1]
+    slot = jnp.where(valid > 0, idx, S).reshape(T * k)
+    row, block_slot, block_rows, Tp = _row_plan(slot, S, bc)
+    tok = (jnp.arange(T * k, dtype=jnp.int32) // k)
+    xs = jnp.zeros((Tp, D), x.dtype).at[row].set(x[tok], mode="drop")
+    wf = jnp.where(slot < S, topw.reshape(T * k), 0).astype(x.dtype)
+    return xs, row, tok, wf, block_slot, block_rows
+
+
+def experts_stage(xs, block_slot, block_rows, wi, wo, wi_scale=None,
+                  wo_scale=None, *, use_pallas: bool = False,
+                  interpret: Optional[bool] = None):
+    """Per-block expert MLP on the sorted buffer: [Tp, D] -> [Tp, D]."""
+    Tp, D = xs.shape
+    bc = Tp // block_slot.shape[0]
+    xb = xs.reshape(-1, bc, D)
+    if use_pallas and wi_scale is None:
+        ye = _experts_pallas(xb, block_slot, block_rows, wi, wo, wi_scale,
+                             wo_scale, interpret)
+    else:
+        ye = _experts_xla(xb, block_slot, block_rows, wi, wo, wi_scale,
+                          wo_scale)
+    return ye.reshape(Tp, D)
+
+
+def combine_stage(ye, row, tok, wf, T: int):
+    """Inverse permutation + router-prob weighting back to [T, D]."""
+    Tp, D = ye.shape
+    g = ye[jnp.minimum(row, Tp - 1)]
+    return jnp.zeros((T, D), ye.dtype).at[tok].add(g * wf[:, None])
+
+
+def sorted_moe_local(x, idx, topw, valid, wi, wo, wi_scale=None,
+                     wo_scale=None, *, use_pallas: bool = False,
+                     interpret: Optional[bool] = None,
+                     bc: Optional[int] = None):
+    """Single-shard token-sorted MoE: gather/scatter only, no collective."""
+    T, D = x.shape
+    S = wi.shape[0]
+    if bc is None:
+        bc = pick_block_size(T * idx.shape[1], S, use_pallas and wi_scale is None)
+    with jax.named_scope("moe_dispatch"):
+        xs, row, tok, wf, block_slot, block_rows = dispatch_stage(
+            x, idx, topw, valid, S, bc)
+    with jax.named_scope("moe_experts"):
+        ye = experts_stage(xs, block_slot, block_rows, wi, wo, wi_scale,
+                           wo_scale, use_pallas=use_pallas, interpret=interpret)
+    with jax.named_scope("moe_combine"):
+        return combine_stage(ye, row, tok, wf, T)
+
+
+# --------------------------------------------------------------------------
+# Wide-EP path: bounded per-rank buckets over lax.all_to_all in shard_map
+# --------------------------------------------------------------------------
+
+
+def _sorted_rows(xr, lslot, Sl, bc, wi_l, wo_l, wis_l, wos_l, use_pallas,
+                 interpret):
+    """Receiver-side expert compute: rows already expanded per copy, one
+    local slot id each. Output row i corresponds to input row i."""
+    n, D = xr.shape
+    row, block_slot, block_rows, Tp = _row_plan(lslot, Sl, bc)
+    xs = jnp.zeros((Tp, D), xr.dtype).at[row].set(xr, mode="drop")
+    ye = experts_stage(xs, block_slot, block_rows, wi_l, wo_l, wis_l, wos_l,
+                       use_pallas=use_pallas, interpret=interpret)
+    return ye[jnp.minimum(row, Tp - 1)]
+
+
+def _ep_moe_body(xl, idxl, wl, vl, wi_l, wo_l, wis_l, wos_l, *, ep: int,
+                 S: int, k: int, use_pallas: bool, interpret):
+    """Per-device body under shard_map. ``xl`` is this (dp, sp) cell's
+    token shard (replicated across ep/tp); ``wi_l`` holds the ``S/ep``
+    expert slots this EP rank owns.
+
+    DeepEP-analog exchange: rank r owns the r-th static 1/ep slice of the
+    token range. Every routed copy of an owned token is bucketed by the
+    rank owning its slot (bucket capacity = ALL of a rank's copies, so the
+    exchange is drop-free by construction), shipped with one
+    ``all_to_all``, computed token-sorted on the owner, and shipped back
+    over the same buckets. Weighting/combine stay at the origin rank.
+    """
+    tl, D = xl.shape
+    Sl = wi_l.shape[0]
+    r = lax.axis_index("ep")
+    if ep == 1:
+        return sorted_moe_local(xl, idxl, wl, vl, wi_l, wo_l, wis_l, wos_l,
+                                use_pallas=use_pallas, interpret=interpret)
+    tpc = tl // ep  # caller pads: tl % ep == 0
+    with jax.named_scope("moe_dispatch"):
+        x_o = lax.dynamic_slice_in_dim(xl, r * tpc, tpc, 0)
+        idx_o = lax.dynamic_slice_in_dim(idxl, r * tpc, tpc, 0)
+        w_o = lax.dynamic_slice_in_dim(wl, r * tpc, tpc, 0)
+        v_o = lax.dynamic_slice_in_dim(vl, r * tpc, tpc, 0)
+        n = tpc * k
+        cap = n  # bounded bucket: worst case all copies target one rank
+        slot = jnp.where(v_o > 0, idx_o, S).reshape(n)
+        dest = jnp.where(slot < S, slot // Sl, ep)  # sentinel: not sent
+        order = jnp.argsort(dest, stable=True)
+        dsort = dest[order]
+        dcnt = jnp.zeros((ep + 1,), jnp.int32).at[dest].add(1)[:ep]
+        dstart = jnp.cumsum(dcnt) - dcnt
+        pos = jnp.arange(n, dtype=jnp.int32) - dstart[jnp.minimum(dsort, ep - 1)]
+        sendrow = jnp.where(dsort < ep, dsort * cap + pos, ep * cap)
+        entry_tok = (order // k).astype(jnp.int32)
+        send_x = jnp.zeros((ep * cap, D), xl.dtype).at[sendrow].set(
+            x_o[entry_tok], mode="drop").reshape(ep, cap, D)
+        send_slot = jnp.full((ep * cap,), -1, jnp.int32).at[sendrow].set(
+            slot[order], mode="drop").reshape(ep, cap)
+        recv_x = lax.all_to_all(send_x, "ep", 0, 0, tiled=True)
+        recv_slot = lax.all_to_all(send_slot, "ep", 0, 0, tiled=True)
+    with jax.named_scope("moe_experts"):
+        rs = recv_slot.reshape(ep * cap)
+        lslot = jnp.where(rs >= 0, rs - r * Sl, Sl)  # -1 pad -> sentinel
+        bc = pick_block_size(ep * cap, Sl, use_pallas and wis_l is None)
+        ye = _sorted_rows(recv_x.reshape(ep * cap, D), lslot, Sl, bc,
+                          wi_l, wo_l, wis_l, wos_l, use_pallas, interpret)
+    with jax.named_scope("moe_combine"):
+        back = lax.all_to_all(ye.reshape(ep, cap, D), "ep", 0, 0, tiled=True)
+        outrow = back.reshape(ep * cap, D)
+        g = outrow[jnp.minimum(sendrow, ep * cap - 1)]
+        wf = (w_o.reshape(n)[order]
+              * (dsort < ep).astype(xl.dtype)).astype(xl.dtype)
+        y_o = jnp.zeros((tpc, D), xl.dtype).at[entry_tok].add(g * wf[:, None])
+        return lax.all_gather(y_o, "ep", axis=0, tiled=True)  # [tl, D]
+
+
+def make_sorted_dispatch(mesh=None, *, use_pallas: bool = False,
+                         interpret: Optional[bool] = None):
+    """Build a ``moe_block`` dispatch_impl closure.
+
+    ``impl(x, idx, topw, valid, wi, wo, wi_scale, wo_scale) -> y``: the
+    router / top-k / EPLB replica choice happened upstream (shared with
+    the einsum path, so routing decisions are identical by construction);
+    this only moves tokens, runs experts, and combines. With a mesh the
+    body runs under shard_map over the full mesh — tokens split over
+    (dp, sp), expert slots over ep (tp is gathered: wide-EP keeps expert
+    banks EP-pure, matching the reference deployment) — and pads the
+    token dim so every axis divides.
+    """
+    if mesh is None:
+        def impl(x, idx, topw, valid, wi, wo, wi_scale=None, wo_scale=None):
+            return sorted_moe_local(x, idx, topw, valid, wi, wo, wi_scale,
+                                    wo_scale, use_pallas=use_pallas,
+                                    interpret=interpret)
+        return impl
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    shape = dict(mesh.shape)
+    dpsp = shape.get("dp", 1) * shape.get("sp", 1)
+    ep = shape.get("ep", 1)
+
+    def impl(x, idx, topw, valid, wi, wo, wi_scale=None, wo_scale=None):
+        T, D = x.shape
+        k = idx.shape[1]
+        S = wi.shape[0]
+        mult = dpsp * ep
+        Tp = ((T + mult - 1) // mult) * mult
+        if Tp != T:
+            pad = ((0, Tp - T),)
+            x = jnp.pad(x, pad + ((0, 0),))
+            idx = jnp.pad(idx, pad + ((0, 0),))
+            topw = jnp.pad(topw, pad + ((0, 0),))
+            valid = jnp.pad(valid, pad + ((0, 0),))  # pad rows invalid
+
+        def body(xl, idxl, wl, vl, wi_l, wo_l, *scales):
+            wis_l = scales[0] if wi_scale is not None else None
+            wos_l = scales[1] if wi_scale is not None else None
+            return _ep_moe_body(xl, idxl, wl, vl, wi_l, wo_l, wis_l, wos_l,
+                                ep=ep, S=S, k=k, use_pallas=use_pallas,
+                                interpret=interpret)
+
+        tok = P(("dp", "sp"), None)
+        in_specs = [tok, tok, tok, tok,
+                    P("ep", None, None), P("ep", None, None)]
+        args = [x, idx, topw, valid, wi, wo]
+        if wi_scale is not None:
+            in_specs += [P("ep", None), P("ep", None)]
+            args += [wi_scale, wo_scale]
+        y = shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                      out_specs=tok, check_rep=False)(*args)
+        return y[:T]
+
+    return impl

@@ -137,7 +137,8 @@ def test_ci_gate_pins_bench_stages():
     src = (ROOT / "tools" / "ci_gate.py").read_text()
     for stage in ("util-check", "bench-tiny-cpu", "bench-tiny-spec",
                   "bench-tiny-attn", "bench-tiny-structured",
-                  "bench-tiny-spec-structured", "bench-tiny-warmstart"):
+                  "bench-tiny-spec-structured", "bench-tiny-warmstart",
+                  "bench-tiny-moe"):
         assert f'"{stage}"' in src, f"ci_gate.py lost bench stage {stage}"
     # the compose smoke must keep its in-process enforcement flag: without
     # it the stage only proves the bench ran, not that constrained rows

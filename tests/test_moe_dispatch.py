@@ -1,0 +1,368 @@
+"""Token-sorted drop-free MoE dispatch (ops/moe_dispatch) vs the legacy
+capacity einsum in models.transformer.moe_block.
+
+Routing (softmax, top-k, renorm, EPLB replica choice) lives in moe_block for
+BOTH paths, so at a capacity factor generous enough that the einsum keeps
+every routed token the two paths compute the same function — parity is exact
+up to summation order. The suite pins that parity across the feature matrix
+(EPLB, int8 banks, token_mask padding, DBO), the drop-free property where the
+legacy path provably drops, recompile-free EPLB rebalance on the engine, and
+the ep-axis all_to_all exchange on the 8-device virtual mesh."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _moe_inputs(seed=0, T=16, dtype=jnp.float32):
+    from llmd_tpu.models import get_model_config
+
+    cfg = get_model_config("tiny-moe")
+    k1, k2, k3, k4 = jax.random.split(jax.random.PRNGKey(seed), 4)
+    D, E, Fe = cfg.hidden_size, cfg.moe_num_experts, cfg.moe_intermediate_size
+    x = jax.random.normal(k1, (T, D), dtype)
+    router = jax.random.normal(k2, (D, E), jnp.float32) * 0.1
+    wi = jax.random.normal(k3, (E, D, 2 * Fe), dtype) * 0.05
+    wo = jax.random.normal(k4, (E, Fe, D), dtype) * 0.05
+    return cfg, x, router, wi, wo
+
+
+def _both_paths(cfg, x, router, wi, wo, **kw):
+    """(y_einsum, y_sorted) at identical routing decisions."""
+    from llmd_tpu.models.transformer import moe_block
+    from llmd_tpu.ops.moe_dispatch import make_sorted_dispatch
+
+    y0, _ = moe_block(cfg, x, router, wi, wo, **kw)
+    y1, _ = moe_block(cfg, x, router, wi, wo,
+                      dispatch_impl=make_sorted_dispatch(), **kw)
+    return np.asarray(y0), np.asarray(y1)
+
+
+# ------------------------------------------------------------------- parity
+
+
+def test_sorted_matches_einsum_fp32():
+    cfg, x, router, wi, wo = _moe_inputs()
+    cfg = replace(cfg, moe_capacity_factor=8.0)  # einsum keeps every token
+    y0, y1 = _both_paths(cfg, x, router, wi, wo)
+    np.testing.assert_allclose(y0, y1, rtol=0, atol=2e-6)
+
+
+def test_sorted_matches_einsum_bf16():
+    cfg, x, router, wi, wo = _moe_inputs(dtype=jnp.bfloat16)
+    cfg = replace(cfg, moe_capacity_factor=8.0, dtype="bfloat16")
+    y0, y1 = _both_paths(cfg, x, router, wi, wo)
+    np.testing.assert_allclose(y0.astype(np.float32), y1.astype(np.float32),
+                               rtol=0, atol=3e-2)
+
+
+def test_sorted_matches_einsum_with_eplb():
+    """EPLB replica choice feeds the sort key: both paths see the same
+    physical slot ids, so redundant-expert placement preserves parity."""
+    from llmd_tpu.parallel.eplb import rebalance
+
+    cfg, x, router, wi, wo = _moe_inputs(T=32)
+    cfg = replace(cfg, moe_capacity_factor=8.0)
+    E = cfg.moe_num_experts
+    loads = np.ones((1, E), np.int64)
+    loads[0, 0] = 100  # hot expert gets the redundant slots
+    s2e, slots, counts = rebalance(loads, E + 4, ep_size=4)
+    eplb = (jnp.asarray(slots[0]), jnp.asarray(counts[0]))
+    y0, y1 = _both_paths(cfg, x, router, wi[s2e[0]], wo[s2e[0]], eplb=eplb)
+    np.testing.assert_allclose(y0, y1, rtol=0, atol=2e-6)
+
+
+def test_sorted_matches_einsum_int8_banks():
+    """Per-slot per-out-channel int8 scales gather with the bank on the
+    sorted path exactly as they broadcast on the einsum path."""
+    cfg, x, router, wi, wo = _moe_inputs()
+    cfg = replace(cfg, moe_capacity_factor=8.0)
+    E, Fe, D = cfg.moe_num_experts, cfg.moe_intermediate_size, cfg.hidden_size
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    wi_q = jax.random.randint(k1, wi.shape, -127, 128, jnp.int8)
+    wo_q = jax.random.randint(k2, wo.shape, -127, 128, jnp.int8)
+    # realistic per-channel scales (amax/127 at weight std 0.05) keep the
+    # activations O(1); the paths differ only in summation order, so the
+    # residual is relative
+    wi_s = jnp.full((E, 2 * Fe), 4e-4, jnp.float32)
+    wo_s = jnp.full((E, D), 4e-4, jnp.float32)
+    y0, y1 = _both_paths(cfg, x, router, wi_q, wo_q,
+                         wi_scale=wi_s, wo_scale=wo_s)
+    np.testing.assert_allclose(y0, y1, rtol=1e-5, atol=1e-5)
+
+
+def test_sorted_matches_einsum_with_token_mask():
+    """Masked (padding) tokens consume no capacity on either path and the
+    outputs agree row for row — including the masked rows."""
+    cfg, x, router, wi, wo = _moe_inputs(T=16)
+    cfg = replace(cfg, moe_capacity_factor=8.0)
+    mask = jnp.asarray(np.arange(16) % 3 != 0, jnp.bool_)
+    y0, y1 = _both_paths(cfg, x, router, wi, wo, token_mask=mask)
+    np.testing.assert_allclose(y0, y1, rtol=0, atol=2e-6)
+
+
+def test_sorted_matches_einsum_with_dbo():
+    """moe_dbo halves the batch upstream of dispatch_impl: both halves run
+    the sorted path independently and concatenate to the full-batch answer."""
+    cfg, x, router, wi, wo = _moe_inputs(T=32)
+    cfg = replace(cfg, moe_capacity_factor=8.0, moe_dbo=True)
+    y0, y1 = _both_paths(cfg, x, router, wi, wo)
+    np.testing.assert_allclose(y0, y1, rtol=0, atol=2e-6)
+    cfg_off = replace(cfg, moe_dbo=False)
+    _, y1_off = _both_paths(cfg_off, x, router, wi, wo)
+    np.testing.assert_allclose(y1, y1_off, rtol=0, atol=2e-6)
+
+
+def test_sorted_pallas_interpret_matches_xla_backend():
+    from llmd_tpu.models.transformer import moe_block
+    from llmd_tpu.ops.moe_dispatch import make_sorted_dispatch
+
+    cfg, x, router, wi, wo = _moe_inputs(T=32)
+    y0, _ = moe_block(cfg, x, router, wi, wo,
+                      dispatch_impl=make_sorted_dispatch())
+    y1, _ = moe_block(cfg, x, router, wi, wo,
+                      dispatch_impl=make_sorted_dispatch(use_pallas=True,
+                                                         interpret=True))
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------- drop-free
+
+
+def test_sorted_drop_free_where_einsum_drops():
+    """At a starved capacity factor the legacy path provably drops routed
+    copies; the sorted path keeps every one and still matches the
+    generous-capacity ground truth."""
+    from llmd_tpu.models.transformer import moe_block
+    from llmd_tpu.ops.moe_dispatch import make_sorted_dispatch
+
+    cfg, x, router, wi, wo = _moe_inputs(T=32)
+    starved = replace(cfg, moe_capacity_factor=0.5)
+    y_e, _, drop_e = moe_block(starved, x, router, wi, wo,
+                               return_dropped=True)
+    assert int(drop_e) > 0, "capacity factor 0.5 dropped nothing on T=32"
+    y_s, _, drop_s = moe_block(starved, x, router, wi, wo,
+                               dispatch_impl=make_sorted_dispatch(),
+                               return_dropped=True)
+    assert int(drop_s) == 0
+    truth, _ = moe_block(replace(cfg, moe_capacity_factor=8.0),
+                         x, router, wi, wo)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(truth),
+                               rtol=0, atol=2e-6)
+    # and the starved einsum really lost those tokens' contributions
+    assert not np.allclose(np.asarray(y_e), np.asarray(truth), atol=1e-4)
+
+
+def test_einsum_drop_count_is_exact():
+    """routed - kept accounting: dropped == sum over slots of
+    max(0, routed_to_slot - C), computed from the routing decisions."""
+    from llmd_tpu.models.transformer import moe_block
+
+    cfg, x, router, wi, wo = _moe_inputs(T=32)
+    cfg = replace(cfg, moe_capacity_factor=0.5)
+    k, S = cfg.moe_top_k, cfg.moe_num_experts
+    logits = np.asarray(x, np.float32) @ np.asarray(router, np.float32)
+    order = np.argsort(-logits, axis=-1)[:, :k]
+    C = max(1, int(32 * k / S * cfg.moe_capacity_factor))
+    per_slot = np.bincount(order.reshape(-1), minlength=S)
+    want = int(np.maximum(0, per_slot - C).sum())
+    _, _, dropped = moe_block(cfg, x, router, wi, wo, return_dropped=True)
+    assert int(dropped) == want
+
+
+# ------------------------------------------------------------- block plan
+
+
+def test_pick_block_size_regimes():
+    from llmd_tpu.ops.moe_dispatch import pick_block_size
+
+    # decode: Tk ~ S -> bc == 1 keeps the padded buffer near-dense
+    assert pick_block_size(8, 8, pallas=False) == 1
+    # prefill: Tk >> S -> MXU-sized blocks, capped at 128
+    assert pick_block_size(4096, 8, pallas=False) == 128
+    assert pick_block_size(100_000, 8, pallas=False) == 128
+    # Pallas tiles need >= 8 sublanes
+    assert pick_block_size(8, 8, pallas=True) == 8
+    for tk in (1, 7, 64, 513):
+        bc = pick_block_size(tk, 16, pallas=False)
+        assert bc & (bc - 1) == 0  # power of two
+
+
+def test_dispatch_stage_places_every_valid_copy():
+    """Every valid (token, k) copy lands in a row of its slot's segment;
+    sentinels land nowhere; combine inverts the permutation exactly."""
+    from llmd_tpu.ops.moe_dispatch import combine_stage, dispatch_stage
+
+    T, D, S, k, bc = 12, 4, 5, 2, 2
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, S, size=(T, k)).astype(np.int32))
+    valid = jnp.asarray((rng.random((T, 1)) < 0.8).astype(np.int32))
+    topw = jnp.full((T, k), 0.5, jnp.float32)
+    xs, row, tok, wf, block_slot, block_rows = dispatch_stage(
+        x, idx, topw, valid, S, bc)
+    rown, xsn = np.asarray(row), np.asarray(xs)
+    Tp = xsn.shape[0]
+    slot = np.where(np.asarray(valid) > 0, np.asarray(idx), S).reshape(-1)
+    live = slot < S
+    # every valid copy has a distinct in-buffer row carrying its token's x
+    assert len(set(rown[live].tolist())) == int(live.sum())
+    for i in np.nonzero(live)[0]:
+        np.testing.assert_array_equal(xsn[rown[i]], np.asarray(x)[i // k])
+        # and that row's block belongs to the copy's slot
+        assert int(np.asarray(block_slot)[rown[i] // bc]) == slot[i]
+    assert np.all(rown[~live] == Tp)  # sentinels scatter off the end
+    assert int(np.asarray(block_rows).sum()) == int(live.sum())
+    # identity experts -> combine is sum of topw-weighted copies
+    y = combine_stage(xs, row, tok, wf, T)
+    want = np.zeros((T, D), np.float32)
+    for i in np.nonzero(live)[0]:
+        want[i // k] += 0.5 * np.asarray(x)[i // k]
+    np.testing.assert_allclose(np.asarray(y), want, rtol=0, atol=1e-6)
+
+
+def test_ragged_all_to_all_feature_detect():
+    from llmd_tpu.ops.moe_dispatch import has_ragged_all_to_all
+
+    # pinned jax 0.4.37 predates the collective; the bucket exchange must
+    # not depend on it either way
+    assert has_ragged_all_to_all() == hasattr(jax.lax, "ragged_all_to_all")
+
+
+# ----------------------------------------------------------------- ep axis
+
+
+def test_ep_all_to_all_matches_local():
+    """The bounded-bucket all_to_all exchange over a real (dp=2, ep=4) mesh
+    computes the same function as the single-shard sorted path."""
+    from llmd_tpu.ops.moe_dispatch import make_sorted_dispatch
+    from llmd_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    mesh = build_mesh(MeshConfig(dp=2, ep=4))
+    T, D, S, k = 24, 16, 8, 2
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, S, size=(T, k)).astype(np.int32))
+    topw = jnp.asarray(rng.random((T, k)).astype(np.float32))
+    valid = jnp.asarray((rng.random((T, 1)) < 0.9).astype(np.int32))
+    wi = jnp.asarray(rng.normal(size=(S, D, 2 * 8)).astype(np.float32) * 0.1)
+    wo = jnp.asarray(rng.normal(size=(S, 8, D)).astype(np.float32) * 0.1)
+    y_local = make_sorted_dispatch()(x, idx, topw, valid, wi, wo)
+    y_ep = make_sorted_dispatch(mesh)(x, idx, topw, valid, wi, wo)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_local),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ep_all_to_all_matches_local_int8():
+    from llmd_tpu.ops.moe_dispatch import make_sorted_dispatch
+    from llmd_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    mesh = build_mesh(MeshConfig(ep=8))
+    T, D, S, k = 16, 8, 8, 2
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(T, D)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, S, size=(T, k)).astype(np.int32))
+    topw = jnp.full((T, k), 0.5, jnp.float32)
+    valid = jnp.ones((T, 1), jnp.int32)
+    wi = jnp.asarray(rng.integers(-127, 128, size=(S, D, 8)).astype(np.int8))
+    wo = jnp.asarray(rng.integers(-127, 128, size=(S, 4, D)).astype(np.int8))
+    wi_s = jnp.full((S, 8), 0.01, jnp.float32)
+    wo_s = jnp.full((S, D), 0.02, jnp.float32)
+    y_local = make_sorted_dispatch()(x, idx, topw, valid, wi, wo, wi_s, wo_s)
+    y_ep = make_sorted_dispatch(mesh)(x, idx, topw, valid, wi, wo, wi_s, wo_s)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_local),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ------------------------------------------------------------------ engine
+
+
+def _tiny_engine(**over):
+    from llmd_tpu.engine import EngineConfig, LLMEngine
+    from llmd_tpu.models import get_model_config
+
+    base = dict(page_size=8, num_pages=64, max_model_len=128,
+                max_batch_size=4, prefill_chunk=16)
+    base.update(over)
+    return LLMEngine(get_model_config("tiny-moe"), EngineConfig(**base),
+                     seed=7)
+
+
+def test_engine_auto_selects_sorted_and_env_overrides(monkeypatch):
+    eng = _tiny_engine()
+    assert eng.moe_dispatch == "sorted"
+    monkeypatch.setenv("LLMD_MOE_DISPATCH", "einsum")
+    assert _tiny_engine().moe_dispatch == "einsum"
+    monkeypatch.delenv("LLMD_MOE_DISPATCH")
+    assert _tiny_engine(moe_dispatch="einsum").moe_dispatch == "einsum"
+    with pytest.raises(ValueError):
+        _tiny_engine(moe_dispatch="bogus")
+
+
+def test_engine_sorted_vs_einsum_greedy_parity_and_drops():
+    from llmd_tpu.core.request import SamplingParams
+
+    prompts = [list(range(3, 30)), list(range(40, 55))]
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
+    eng_s = _tiny_engine(moe_dispatch="sorted")
+    out_s = eng_s.generate(prompts, sp)
+    assert eng_s.stats.moe_dropped_tokens == 0
+    eng_e = _tiny_engine(moe_dispatch="einsum")
+    out_e = eng_e.generate(prompts, sp)
+    if eng_e.stats.moe_dropped_tokens == 0:
+        # nothing dropped -> identical math -> identical greedy outputs
+        assert out_s == out_e
+
+
+def test_engine_eplb_rebalance_no_recompile_on_sorted():
+    """Skewed load forces real placement changes; the sorted path's bucket
+    shapes are static, so rebalances must regather weights WITHOUT growing
+    any program cache (the zero-recompile acceptance criterion)."""
+    from llmd_tpu.core.request import SamplingParams
+    from llmd_tpu.parallel.eplb import EPLBConfig
+
+    eng = _tiny_engine(eplb=EPLBConfig(window_size=8, step_interval=2,
+                                       num_redundant_experts=4))
+    assert eng.moe_dispatch == "sorted"
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
+    # warmup: compiles every program this workload uses, crosses >= 1 rebalance
+    eng.generate([list(range(3, 30)), list(range(50, 70))], sp)
+    reb0 = eng.stats.eplb_rebalances
+    sizes0 = {name: fn._cache_size()
+              for name, fn in [("decode", eng._decode_multi_fn)]
+              if hasattr(fn, "_cache_size")}
+    assert sizes0, "decode program exposes no _cache_size"
+    # steady state at the same shapes: rebalances continue, compiles don't
+    eng.generate([list(range(7, 34)), list(range(90, 110))], sp)
+    assert eng.stats.eplb_rebalances > reb0
+    for name, fn in [("decode", eng._decode_multi_fn)]:
+        if hasattr(fn, "_cache_size"):
+            assert fn._cache_size() == sizes0[name], (
+                f"{name} recompiled across EPLB rebalance")
+
+
+def test_engine_ep_imbalance_gauge_stamped():
+    from llmd_tpu.core.request import SamplingParams
+    from llmd_tpu.parallel.eplb import EPLBConfig
+
+    eng = _tiny_engine(eplb=EPLBConfig(window_size=8, step_interval=2,
+                                       num_redundant_experts=4))
+    eng.generate([list(range(3, 30))],
+                 SamplingParams(max_tokens=8, temperature=0.0))
+    vals = {}
+    for name, labels, value in eng.metrics.registry.collect():
+        if name == "llmd_tpu:moe_ep_load_imbalance":
+            vals[labels] = value
+    whens = {lbl.strip("{}").split("=")[1].strip('"') for lbl in vals}
+    assert whens == {"before", "after"}, vals
+    assert all(v >= 1.0 - 1e-9 for v in vals.values()), vals

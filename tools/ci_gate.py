@@ -134,6 +134,11 @@ def main() -> int:
         stages.append(("bench-tiny-warmstart",
                        [py, "tools/warm_start_probe.py", "--cpu",
                         "--cache-dir", "campaign_logs/ci_warm_cache"], None))
+        # MoE dispatch smoke: tiny-moe engine A/B on CPU — sorted path
+        # selected, greedy parity vs the einsum reference, zero drops on
+        # sorted and provable drops on capacity-starved einsum
+        stages.append(("bench-tiny-moe",
+                       [py, "tools/moe_check.py"], CPU_ENV))
     if not args.skip_dryrun:
         n = 2 if args.quick else 8
         stages.append((f"dryrun-multichip-{n}",

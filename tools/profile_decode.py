@@ -111,7 +111,7 @@ def main() -> None:
 
         def step(params, carry, _):
             cache, toks, pos, lens = carry
-            hidden, cache, _ = forward_core(
+            hidden, cache, _, _ = forward_core(
                 cfg, params, cache, toks, pos, seq_slots, pts, lens,
                 cu_q_lens=cu, num_seqs=ns, attn_impl=attn_impl)
             if mode in ("no-unembed", "no-attn"):
@@ -176,7 +176,7 @@ def main() -> None:
             impl = null_attn if mode == "prefill-no-attn" else attn
 
             def pf(params, cache, toks):
-                hidden, cache, _ = forward_core(
+                hidden, cache, _, _ = forward_core(
                     cfg, params, cache, toks, pos_p, slots_p, pts, lens_p,
                     cu_q_lens=cu_p, num_seqs=ns, attn_impl=impl)
                 last = hidden[cu_p[1:] - 1]

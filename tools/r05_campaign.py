@@ -54,6 +54,16 @@ POINTS: list[tuple] = [
     # probe above turns a repeat death into fast skips instead of 30 min of
     # serial preflights
     ("int8-b128", ["--quantize", "int8", "--batch", "128"]),
+    # token-sorted MoE dispatch A/B (PERF.md Lever 14) on the MoE-wide MLA
+    # registry shape: sorted drop-free gather/scatter dispatch vs the legacy
+    # capacity einsum at matched routing decisions. The pair's decode tok/s
+    # delta is the lever's on-chip number; drop + comm-byte provenance rides
+    # the JSON row (moe_dropped_tokens / moe_comm_bytes). Not best_serving-
+    # eligible (different model), like the mla-decode pair.
+    ("int8-b64-moe-sorted", ["--model", "moe-wide-mla", "--quantize", "int8",
+                             "--batch", "64", "--moe-dispatch", "sorted"]),
+    ("int8-b64-moe-einsum", ["--model", "moe-wide-mla", "--quantize", "int8",
+                             "--batch", "64", "--moe-dispatch", "einsum"]),
     # layer-scan unroll A/B at the serving default: can XLA hide part of the
     # weight stream behind compute across layer boundaries?
     # speculative decoding A/B vs the harvested int8-b64 row (4,042 tok/s):
@@ -251,6 +261,7 @@ def main() -> None:
         serving = [r for r in merged
                    if r.get("value")
                    and not r["point"].startswith(("longctx", "mla-", "warm-"))
+                   and "-moe-" not in r["point"]
                    and r.get("metric") == "output_tok_per_s_per_chip"
                    and r.get("workload", "uniform") == "uniform"]
         best = max(serving, key=lambda r: r["value"]) if serving else None
