@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane decisions durable perf-regress util moe
+.PHONY: check check-quick test bench dryrun lint manifests chaos structured slo device-obs kvplane decisions durable perf-regress util moe pd
 
 # full gate: lint + manifests + suite + tiny bench + 8-device dryrun
 check:
@@ -41,6 +41,11 @@ structured:
 # autoscaling SLO gate: 10x burst + replica chaos, zero 5xx, warm 0->1
 slo:
 	JAX_PLATFORMS=cpu $(PY) tools/slo_check.py
+
+# P/D disaggregation: role-labeled pools, predictor-gated splits, kv_pull
+# ledgers, mid-burst prefill-pool kill degrades to aggregated, zero 5xx
+pd:
+	JAX_PLATFORMS=cpu $(PY) tools/pd_check.py
 
 # device plane: watchdog, fabric probe, HBM gauges, profiler capture
 device-obs:

@@ -90,7 +90,7 @@ def _router_ledger(rec: dict, events: list, schedules: list) -> dict:
     }
     if final.get("regret") is not None:
         ledger["regret"] = final["regret"]
-    for k in ("resilience_dropped", "excluded", "breakers", "kv_plane"):
+    for k in ("resilience_dropped", "excluded", "breakers", "kv_plane", "pd"):
         if final.get(k):
             ledger[k] = final[k]
 

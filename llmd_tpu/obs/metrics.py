@@ -769,6 +769,9 @@ class RouterMetrics:
             "llm_d_epp_rejected_total", "Requests the scheduler rejected")
         self.pd_splits = reg.counter(
             "llm_d_epp_pd_splits_total", "Prefill/decode disaggregated splits")
+        self.pd_aggregated = reg.counter(
+            "llm_d_epp_pd_aggregated_total",
+            "Disagg decider picks that stayed aggregated (hop skipped)")
         self.flow_enqueued = reg.counter(
             "llm_d_epp_flow_enqueued_total", "Requests admitted to flow queues")
         self.flow_dispatched = reg.counter(

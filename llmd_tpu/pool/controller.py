@@ -78,6 +78,7 @@ class PoolConfig:
     ready_timeout_s: float = 60.0
     policy: str = "max"  # "wva" | "hpa" | "max" (max of both)
     health_timeout_s: float = 1.0
+    role: str = "both"  # prefill | decode | both — stamped on Endpoints
 
     @classmethod
     def from_env(cls, **overrides: Any) -> "PoolConfig":
@@ -363,9 +364,14 @@ class PoolController:
         if self.families is not None:
             self.families.warm_start.labels(kind=kind).observe(dt)
         self.replicas[handle.address] = handle
+        from llmd_tpu.core.endpoint import EndpointRole
+
+        role = getattr(handle, "role", None) or self.cfg.role
         self.pool.upsert(Endpoint(
             address=handle.address, name=handle.name,
-            labels={"llmd.ai/pool": self.cfg.model}))
+            role=EndpointRole(role),
+            labels={"llmd.ai/pool": self.cfg.model,
+                    "llmd.ai/role": role}))
         if self.flight is not None:
             self.flight.record_system(
                 "pool_warm_start", endpoint=handle.address, kind=kind,

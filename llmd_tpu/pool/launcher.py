@@ -40,6 +40,8 @@ class ReplicaHandle:
     launched_at: float = field(default_factory=time.monotonic)
     server: Any = None  # in-process FakeModelServer (fake launcher)
     proc: Any = None  # subprocess.Popen (process launcher)
+    role: str = "both"  # prefill | decode | both — copied onto the Endpoint
+    sidecar: Any = None  # RoutingSidecar fronting a decode replica
 
     def __post_init__(self) -> None:
         if not self.name:
@@ -78,7 +80,9 @@ class FakeReplicaLauncher(ReplicaLauncher):
                  engine_config: Optional[dict] = None,
                  engine_build_s: float = 0.0,
                  restore_s: float = 0.0,
-                 durable_store: bool = False) -> None:
+                 durable_store: bool = False,
+                 role: str = "both",
+                 with_sidecar: bool = False) -> None:
         from llmd_tpu.testing.fake_server import FakeServerConfig
 
         self.server_config = server_config or FakeServerConfig()
@@ -99,6 +103,12 @@ class FakeReplicaLauncher(ReplicaLauncher):
         # harnesses (tools/slo_check.py) should see restored prefixes.
         self.durable_store = durable_store
         self.durable_blocks: set[int] = set()
+        # P/D disaggregation (docs/pd-disaggregation.md): role is stamped on
+        # the replica config and the handle so the controller can label the
+        # Endpoint; with_sidecar fronts decode replicas with a RoutingSidecar
+        # that executes the x-prefiller-host-port split the router decides.
+        self.role = role
+        self.with_sidecar = with_sidecar
         self._seq = 0
 
     async def launch(self) -> ReplicaHandle:
@@ -115,7 +125,10 @@ class FakeReplicaLauncher(ReplicaLauncher):
             if self.snapshots is not None:
                 self.snapshots.save(fp, {"kind": "fake",
                                          "engine_config": self.engine_config})
-        server = FakeModelServer(copy.deepcopy(self.server_config))
+        cfg = copy.deepcopy(self.server_config)
+        if self.role != "both":
+            cfg.role = self.role
+        server = FakeModelServer(cfg)
         if self.durable_store and self.durable_blocks:
             # restore the written-back prefix working set into the simulated
             # paged cache: repeats hit these blocks, so prefill (∝ uncached
@@ -125,11 +138,23 @@ class FakeReplicaLauncher(ReplicaLauncher):
                 server.blocks[h] = now
         await server.start()
         self._seq += 1
-        return ReplicaHandle(address=server.address,
+        sidecar = None
+        address = server.address
+        if self.with_sidecar:
+            from llmd_tpu.disagg.sidecar import RoutingSidecar
+
+            sidecar = RoutingSidecar(decode_addr=server.address,
+                                     prefill_timeout_s=2.0)
+            await sidecar.start()
+            address = sidecar.address  # traffic enters through the sidecar
+        return ReplicaHandle(address=address,
                              name=f"fake-{self._seq}", warm=warm,
-                             server=server)
+                             server=server, role=self.role, sidecar=sidecar)
 
     async def stop(self, handle: ReplicaHandle) -> None:
+        if handle.sidecar is not None:
+            sidecar, handle.sidecar = handle.sidecar, None
+            await sidecar.stop()
         if handle.server is not None:
             if self.durable_store:
                 # drain-time write-back: the controller drained before this
@@ -141,6 +166,9 @@ class FakeReplicaLauncher(ReplicaLauncher):
         # aiohttp cleanup cancels in-flight handlers: clients see resets,
         # which is the abrupt-death signal the chaos gate wants. No durable
         # write-back: an abrupt death never ran the drain flush.
+        if handle.sidecar is not None:
+            sidecar, handle.sidecar = handle.sidecar, None
+            await sidecar.stop()
         if handle.server is not None:
             server, handle.server = handle.server, None
             await server.stop()
@@ -157,13 +185,17 @@ def _free_port(host: str = "127.0.0.1") -> int:
 
 def fake_argv(port: int, *, model: str = "fake/model", block_size: int = 16,
               num_blocks: int = 512, max_running: int = 8,
-              decode_us_per_token: float = 500.0) -> list[str]:
+              decode_us_per_token: float = 500.0,
+              role: str = "both") -> list[str]:
     """argv for a subprocess FakeModelServer (testing/fake_server.py CLI)."""
-    return [sys.executable, "-m", "llmd_tpu.testing.fake_server",
+    argv = [sys.executable, "-m", "llmd_tpu.testing.fake_server",
             "--port", str(port), "--model", model,
             "--block-size", str(block_size), "--num-blocks", str(num_blocks),
             "--max-running", str(max_running),
             "--decode-us-per-token", str(decode_us_per_token)]
+    if role != "both":
+        argv += ["--role", role]
+    return argv
 
 
 def engine_argv(model: str, port: int,

@@ -8,6 +8,8 @@ uncached-suffix decider).
 
 from __future__ import annotations
 
+import math
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
@@ -25,6 +27,7 @@ from llmd_tpu.router.plugins import (
 )
 from llmd_tpu.obs.decisions import decisions_enabled
 from llmd_tpu.router.scorers import (
+    STATE_PREDICTED,
     STATE_PREFIX_HITS,
     STATE_TOKEN_IDS,
     clamp_scores,
@@ -55,6 +58,10 @@ class SchedulingResult:
     # Candidates removed before any profile ran ({"excluded": n,
     # "resilience_dropped": n}); None when the decision ledger is off.
     pre_drops: Optional[dict] = None
+    # Disagg decider outcome (docs/pd-disaggregation.md): decision, reason,
+    # predicted TTFT deltas, priced kv_pull hop, and the chosen P/D pair.
+    # None outside the disagg-profile-handler.
+    pd: Optional[dict] = None
 
 
 class Profile:
@@ -127,10 +134,22 @@ class Scheduler:
             p for p in self.plugins.values() if isinstance(p, Admitter)
         ]
         self.handler = config.profile_handler
-        # disagg decider params (pd-disaggregation values: always / uncached-suffix)
+        # disagg decider params (docs/pd-disaggregation.md): the config's
+        # uncachedSuffixThreshold wins; LLMD_PD_THRESHOLD_TOKENS is the env
+        # fallback when the config leaves it unset. The kv_pull hop price
+        # (base + per-block transfer cost) and the decision margin gate the
+        # predictor comparison in _pd_decide.
         raw_fc = config.raw.get("disaggregation", {}) or {}
-        self.pd_threshold_tokens = int(raw_fc.get("uncachedSuffixThreshold", 0))
-        self.metrics = {"scheduled_total": 0, "rejected_total": 0, "pd_splits_total": 0}
+        self.pd_threshold_tokens = int(raw_fc.get(
+            "uncachedSuffixThreshold",
+            os.environ.get("LLMD_PD_THRESHOLD_TOKENS", "0") or 0))
+        self.pd_kv_pull_base_ms = float(
+            os.environ.get("LLMD_PD_KV_PULL_BASE_MS", "2.0"))
+        self.pd_kv_pull_ms_per_block = float(
+            os.environ.get("LLMD_PD_KV_PULL_MS_PER_BLOCK", "0.5"))
+        self.pd_margin_ms = float(os.environ.get("LLMD_PD_MARGIN_MS", "0.0"))
+        self.metrics = {"scheduled_total": 0, "rejected_total": 0,
+                        "pd_splits_total": 0, "pd_aggregated_total": 0}
         # Resilience hook (router/resilience.py): filters breaker-open and
         # draining endpoints out of every pick. None = no filtering.
         self.endpoint_filter: Optional[Callable[[list[Endpoint]], list[Endpoint]]] = None
@@ -206,11 +225,15 @@ class Scheduler:
                                 rejected=None if run.endpoint else "no endpoint passed filters")
 
     def _schedule_disagg(self, req, endpoints) -> SchedulingResult:
-        """Decode profile first; decider on uncached suffix; maybe prefill profile.
+        """Decode profile first; predictor-gated decider; maybe prefill profile.
 
-        Reference disaggregation/README.md:57-91: run decode profile → compute the
-        uncached suffix on the chosen D endpoint → if large enough, run prefill
-        profile and return P in the x-prefiller-host-port header.
+        Reference disaggregation/README.md:57-91: run decode profile → compute
+        the uncached suffix on the chosen D endpoint → if large enough, run the
+        prefill profile and consult the latency predictor: disaggregate only
+        when predicted TTFT-on-P plus the priced kv_pull hop beats aggregated
+        prefill on D. Short/cached prompts skip the hop before the prefill
+        profile ever runs. The outcome (decision, reason, predicted deltas,
+        chosen P/D pair) is stamped into ``result.pd`` for the decision ledger.
         """
         dec_prof = self._profile("decode") or self._profile("default")
         if dec_prof is None:
@@ -220,18 +243,74 @@ class Scheduler:
             return SchedulingResult(None, rejected="no decode endpoint")
         result = SchedulingResult(dec.endpoint, profiles={dec_prof.name: dec})
 
-        pre_prof = self._profile("prefill")
-        if pre_prof is None:
-            return result
         hits = req.state.get(STATE_PREFIX_HITS) or {}
         n_tokens = len(req.state.get(STATE_TOKEN_IDS) or req.prompt_text().encode())
         uncached = n_tokens - hits.get(dec.endpoint.address, 0)
+        pre_prof = self._profile("prefill")
+        if pre_prof is None:
+            result.pd = self._pd_aggregated(req, dec.endpoint,
+                                            "no_prefill_profile", uncached)
+            return result
         if uncached < self.pd_threshold_tokens:
-            return result  # short uncached suffix: decode-only (aggregated)
+            # short uncached suffix: decode-only (aggregated), hop skipped
+            result.pd = self._pd_aggregated(req, dec.endpoint,
+                                            "short_uncached_suffix", uncached)
+            return result
         pre = pre_prof.run(req, [e for e in endpoints if e != dec.endpoint] or endpoints,
                            detail=self.record_decisions)
-        if pre.endpoint is not None:
+        if pre.endpoint is None:
+            result.pd = self._pd_aggregated(req, dec.endpoint,
+                                            "no_prefill_endpoint", uncached)
+            return result
+        result.profiles[pre_prof.name] = pre
+        result.pd = self._pd_decide(req, dec.endpoint, pre.endpoint, uncached)
+        if result.pd["decision"] == "split":
             result.prefill_endpoint = pre.endpoint
-            result.profiles[pre_prof.name] = pre
             self.metrics["pd_splits_total"] += 1
+        else:
+            self.metrics["pd_aggregated_total"] += 1
         return result
+
+    # ------------------------------------------------------------ pd decider
+    def _pd_hop_ms(self, uncached: int) -> float:
+        """Priced kv_pull hop: P→D transfer of the uncached suffix's blocks."""
+        blocks = math.ceil(max(0, uncached) / 16)
+        return self.pd_kv_pull_base_ms + self.pd_kv_pull_ms_per_block * blocks
+
+    def _pd_aggregated(self, req, dec_ep, reason: str, uncached: int) -> dict:
+        self.metrics["pd_aggregated_total"] += 1
+        pd = {"decision": "aggregated", "reason": reason,
+              "uncached_tokens": uncached,
+              "hop_ms": round(self._pd_hop_ms(uncached), 3),
+              "decode": dec_ep.address}
+        pred = (req.state.get(STATE_PREDICTED) or {}).get(dec_ep.address)
+        if pred is not None:
+            pd["ttft_agg_ms"] = round(float(pred[0]), 3)
+        return pd
+
+    def _pd_decide(self, req, dec_ep, pre_ep, uncached: int) -> dict:
+        """Split iff predicted TTFT on P + hop beats aggregated prefill on D.
+
+        Without predictor stamps (no predicted-latency-producer in the config)
+        the decider degrades to the legacy threshold-only behavior: past the
+        uncached-suffix threshold, always split.
+        """
+        preds = req.state.get(STATE_PREDICTED) or {}
+        dec_pred = preds.get(dec_ep.address)
+        pre_pred = preds.get(pre_ep.address)
+        hop_ms = self._pd_hop_ms(uncached)
+        pd = {"uncached_tokens": uncached, "hop_ms": round(hop_ms, 3),
+              "prefill": pre_ep.address, "decode": dec_ep.address}
+        if dec_pred is None or pre_pred is None:
+            pd.update(decision="split", reason="no_predictor")
+            return pd
+        ttft_agg = float(dec_pred[0])  # prefill runs on D, no hop
+        ttft_split = float(pre_pred[0]) + hop_ms  # prefill on P, then pull
+        split = ttft_split + self.pd_margin_ms < ttft_agg
+        pd.update(
+            decision="split" if split else "aggregated",
+            reason="predicted_ttft" if split else "hop_not_worth_it",
+            ttft_agg_ms=round(ttft_agg, 3),
+            ttft_split_ms=round(ttft_split, 3),
+            delta_ms=round(ttft_agg - ttft_split, 3))
+        return pd

@@ -224,6 +224,8 @@ class RouterServer:
         self.metrics.scheduled.set_function(lambda: sched["scheduled_total"])
         self.metrics.rejected.set_function(lambda: sched["rejected_total"])
         self.metrics.pd_splits.set_function(lambda: sched["pd_splits_total"])
+        self.metrics.pd_aggregated.set_function(
+            lambda: sched["pd_aggregated_total"])
         for fam, key in ((self.metrics.flow_enqueued, "enqueued_total"),
                          (self.metrics.flow_dispatched, "dispatched_total"),
                          (self.metrics.flow_rejected_capacity,
@@ -526,6 +528,9 @@ class RouterServer:
                 payload["predicted_ttft_ms"] = round(float(pred[0]), 3)
                 payload["predicted_e2e_ms"] = round(
                     predicted_e2e_ms(req, pred), 3)
+        pd = getattr(result, "pd", None)
+        if pd:
+            payload["pd"] = pd  # disagg decider outcome + predicted deltas
         return payload
 
     def _record_route_decision(self, req: InferenceRequest, result,

@@ -52,6 +52,7 @@ class FakeServerConfig:
     num_blocks: int = 512
     prefill_us_per_token: float = 50.0  # uncached prompt tokens
     decode_us_per_token: float = 500.0
+    kv_pull_us_per_block: float = 200.0  # P→D remote-prefill transfer cost
     max_running: int = 8
     kv_events_port: Optional[int] = None  # bind tcp://*:port when set (pod-discovery mode)
     role: str = "both"  # prefill | decode | both
@@ -106,6 +107,12 @@ class FakeModelServer:
         # cross-engine prefix-pull simulation (docs/kv-plane.md)
         self.pulls_completed = 0
         self.pulled_blocks = 0
+        # P/D disaggregation (docs/pd-disaggregation.md): count of requests
+        # that adopted a remote prefiller's KV instead of prefilling locally
+        self.remote_pulls = 0
+        # per-request phase timelines in the flight-record to_dict() shape,
+        # so gates can fold them with obs.attribution.build_ledger verbatim
+        self.request_records: list[dict] = []
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> None:
@@ -275,13 +282,35 @@ class FakeModelServer:
         return None
 
     # -- handlers ----------------------------------------------------------
+    def _close_record(self, rid: str, events: list[dict], t_open: float,
+                      status: str = "finished") -> None:
+        """Retire one request's phase timeline. ``latency_ms`` is the retired
+        stamp itself, so build_ledger's intervals partition the wall exactly."""
+        events.append({"event": "retired",
+                       "t_ms": round((time.monotonic() - t_open) * 1e3, 3)})
+        self.request_records.append({
+            "request_id": rid, "model": self.cfg.model, "status": status,
+            "latency_ms": events[-1]["t_ms"], "events": events})
+        if len(self.request_records) > 4096:
+            del self.request_records[: len(self.request_records) - 4096]
+
     async def _serve_generation(self, request: web.Request, prompt: str, body: dict, chat: bool):
         lora = body.get("model") if body.get("model") in self.cfg.lora_adapters else None
         token_ids = fake_tokenize(prompt)
         max_tokens = int(body.get("max_tokens", 16))
         stream = bool(body.get("stream", False))
+        # kv_transfer_params flow for P/D (disaggregation/README.md:104-131).
+        kv_params = body.get("kv_transfer_params") or {}
         self.request_count += 1
         self.received.append({"prompt": prompt, "body": body, "t": time.monotonic()})
+        if self.cfg.role == "prefill" and not kv_params.get("do_remote_decode"):
+            # prefill-only replica: decode-phase work must carry the P/D
+            # handshake. A client error, never a 5xx — misrouted traffic
+            # should bounce to the sender, not trip breakers/retries.
+            return web.json_response(
+                {"error": {"message": "prefill-only replica refuses decode "
+                                      "work (missing do_remote_decode)",
+                           "type": "invalid_request_error"}}, status=400)
         if self.draining:
             return web.json_response({"error": {"message": "draining"}},
                                      status=503, headers={"Retry-After": "1"})
@@ -292,23 +321,49 @@ class FakeModelServer:
         hangup = (stream and self.faults.midstream_hangup_rate > 0
                   and self._fault_rng.random() < self.faults.midstream_hangup_rate)
 
+        t_open = time.monotonic()
+        events: list[dict] = []
+
+        def ev(name: str) -> None:
+            events.append({"event": name,
+                           "t_ms": round((time.monotonic() - t_open) * 1e3, 3)})
+
+        rid = f"cmpl-{uuid.uuid4().hex[:12]}"
+        remote_pull = bool(kv_params.get("do_remote_prefill")
+                           and kv_params.get("remote_request_id"))
+        if remote_pull:
+            # P/D split decode side: price the P→D transfer per block, then
+            # adopt the prompt's whole chain — local prefill is skipped, and
+            # the phase ledger shows kv_pull where prefill would have been
+            keys = block_keys_for_tokens(token_ids, self.cfg.block_size, lora)
+            await asyncio.sleep(
+                max(1, len(keys)) * self.cfg.kv_pull_us_per_block / 1e6)
+            now = time.monotonic()
+            for k in keys:
+                self.blocks[k] = now
+                self.blocks.move_to_end(k)
+            self.remote_pulls += 1
+            ev("kv_pull")
+        else:
+            ev("arrival")
+
         self.queued += 1
         async with self._admit:  # FIFO-ish admission, no busy-wait
             self.queued -= 1
             self.running += 1
             try:
-                kv_params = body.get("kv_transfer_params") or {}
                 if kv_params.get("do_prefix_pull") and kv_params.get("block_hashes"):
                     await self._simulate_prefix_pull(
                         token_ids, lora, kv_params["block_hashes"])
                 cached = await self._touch_blocks(token_ids, lora)
+                if remote_pull:
+                    cached = len(token_ids)  # full KV arrived from P
                 uncached = max(0, len(token_ids) - cached)
                 prefill_s = (uncached * self.cfg.prefill_us_per_token / 1e6
                              + self._injected_delay(self.faults.first_byte_delay_s))
                 tpot_s = (self.cfg.decode_us_per_token / 1e6
                           + self._injected_delay(self.faults.decode_delay_s))
-                # kv_transfer_params flow for P/D (disaggregation/README.md:104-131).
-                rid = f"cmpl-{uuid.uuid4().hex[:12]}"
+                ev("admitted")
                 model = body.get("model", self.cfg.model)
                 usage = {
                     "prompt_tokens": len(token_ids), "completion_tokens": max_tokens,
@@ -318,7 +373,11 @@ class FakeModelServer:
                 if stream:
                     resp = web.StreamResponse(headers={"Content-Type": "text/event-stream"})
                     await resp.prepare(request)
+                    if not remote_pull:
+                        ev("prefill_start")
                     await asyncio.sleep(prefill_s)
+                    if not remote_pull:
+                        ev("prefill_end")
                     for i in range(max_tokens):
                         if hangup and i == 1:
                             # mid-stream hangup AFTER the first chunk: the
@@ -327,6 +386,8 @@ class FakeModelServer:
                             self.fault_counts["midstream"] += 1
                             self._refuse(request)
                         await asyncio.sleep(tpot_s)
+                        if i == 0:
+                            ev("first_token")
                         chunk = {
                             "id": rid, "model": model, "created": int(time.time()),
                             "object": "chat.completion.chunk" if chat else "text_completion",
@@ -338,11 +399,22 @@ class FakeModelServer:
                         if i == max_tokens - 1:
                             chunk["usage"] = usage
                         await resp.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                    ev("decode")
                     await resp.write(b"data: [DONE]\n\n")
                     await resp.write_eof()
+                    self._close_record(rid, events, t_open)
                     return resp
 
-                await asyncio.sleep(prefill_s + max_tokens * tpot_s)
+                if not remote_pull:
+                    ev("prefill_start")
+                await asyncio.sleep(prefill_s)
+                if not remote_pull:
+                    ev("prefill_end")
+                await asyncio.sleep(tpot_s)
+                ev("first_token")
+                if max_tokens > 1:
+                    await asyncio.sleep((max_tokens - 1) * tpot_s)
+                ev("decode")
                 text = f"echo({len(token_ids)}t,{max_tokens}o)"
                 out: dict = {
                     "id": rid, "object": "chat.completion" if chat else "text_completion",
@@ -357,6 +429,7 @@ class FakeModelServer:
                         "remote_host": self.host, "remote_port": self.port,
                         "remote_request_id": rid, "remote_block_ids": list(range(len(token_ids) // self.cfg.block_size)),
                     }
+                self._close_record(rid, events, t_open)
                 return web.json_response(out)
             finally:
                 self.running -= 1
@@ -407,6 +480,13 @@ class FakeModelServer:
             f"vllm:kv_cache_usage_perc {util:.6f}",
             f'vllm:cache_config_info{{block_size="{self.cfg.block_size}",num_gpu_blocks="{self.cfg.num_blocks}"}} 1',
         ]
+        if self.cfg.role == "decode":
+            # decode replicas advertise the kv-transfer side channel the
+            # prefiller pushes into (disaggregation/README.md:104-131)
+            lines.append(
+                f'vllm:kv_transfer_config_info{{kv_role="kv_consumer",'
+                f'side_channel_host="{self.host}",'
+                f'side_channel_port="{self.port}"}} 1')
         if self.cfg.lora_adapters:
             running = ",".join(self.cfg.lora_adapters[:1])
             lines.append(
@@ -421,7 +501,7 @@ class FakeModelServer:
         if self.draining:
             return web.json_response(
                 {"status": "draining", "inflight": self.running}, status=503)
-        return web.json_response({"status": "ok"})
+        return web.json_response({"status": "ok", "role": self.cfg.role})
 
     async def _drain(self, request: web.Request) -> web.Response:
         """Engine-server /drain contract: stop admissions, wait (bounded) for
@@ -468,6 +548,7 @@ def main() -> int:
     ap.add_argument("--max-running", type=int, default=8)
     ap.add_argument("--prefill-us-per-token", type=float, default=50.0)
     ap.add_argument("--decode-us-per-token", type=float, default=500.0)
+    ap.add_argument("--kv-pull-us-per-block", type=float, default=200.0)
     ap.add_argument("--role", default="both",
                     choices=["prefill", "decode", "both"])
     args = ap.parse_args()
@@ -476,7 +557,8 @@ def main() -> int:
         model=args.model, block_size=args.block_size,
         num_blocks=args.num_blocks, max_running=args.max_running,
         prefill_us_per_token=args.prefill_us_per_token,
-        decode_us_per_token=args.decode_us_per_token, role=args.role)
+        decode_us_per_token=args.decode_us_per_token,
+        kv_pull_us_per_block=args.kv_pull_us_per_block, role=args.role)
     server = FakeModelServer(cfg, host=args.host, port=args.port)
 
     async def run() -> None:

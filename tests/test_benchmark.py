@@ -7,6 +7,7 @@ from __future__ import annotations
 import asyncio
 
 import conftest  # noqa: F401
+import pytest
 from conftest import run_async
 
 from llmd_tpu.benchmark.harness import (
@@ -96,6 +97,7 @@ def _sched_tool():
     return mod
 
 
+@pytest.mark.slow  # ~10s: head-to-head load runs against both routers
 def test_scheduler_beats_round_robin_on_shared_prefix():
     """The headline property, hardware-free: prefix-aware scheduling beats RR
     when the shared-prefix working set only fits if placement is sticky."""
@@ -112,6 +114,7 @@ def test_scheduler_beats_round_robin_on_shared_prefix():
     assert epp["ttft_mean_ms"] < rr["ttft_mean_ms"]
 
 
+@pytest.mark.slow  # ~50s: full rate ladder across the workload matrix
 def test_rate_ladder_matrix_reports_knees():
     """Ladder mode (VERDICT r4 #9): rate sweep x 2 profiles x {RR, EPP}, a
     saturation knee per target, and the EPP's knee >= RR's on shared-prefix."""

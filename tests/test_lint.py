@@ -4,6 +4,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 ROOT = Path(__file__).resolve().parent.parent
 
 
@@ -109,6 +111,25 @@ def test_observability_kit_validates():
     assert len(names) >= 8
 
 
+def test_ci_gate_pins_stage_roster():
+    """The check-stage roster is a contract: every gate the composite
+    promises (including the P/D disaggregation gate, pd-check) must stay
+    declared in ci_gate.py, in order. Pinned by source scan so tier-1 keeps
+    the wiring check without paying the composite's wall clock."""
+    src = (ROOT / "tools" / "ci_gate.py").read_text()
+    roster = ["lint-envvars", "lint-metrics", "lint-events", "llmd-lint",
+              "validate-manifests", "chaos-check", "structured-check",
+              "slo-check", "device-obs", "kv-plane-check", "decision-check",
+              "kv-durability-check", "pd-check", "perf-regress"]
+    positions = []
+    for stage in roster:
+        idx = src.find(f'"{stage}"')
+        assert idx != -1, f"ci_gate.py lost check stage {stage}"
+        positions.append(idx)
+    assert positions == sorted(positions), "ci_gate.py stage order drifted"
+
+
+@pytest.mark.slow  # ~95s: actually runs the lint/check composite end to end
 def test_ci_gate_composes_stages():
     """tools/ci_gate.py (VERDICT r4 missing #3): one command, one exit code,
     a JSON stage summary on the last line."""
@@ -125,7 +146,7 @@ def test_ci_gate_composes_stages():
         "lint-envvars", "lint-metrics", "lint-events", "llmd-lint",
         "validate-manifests", "chaos-check", "structured-check", "slo-check",
         "device-obs", "kv-plane-check", "decision-check",
-        "kv-durability-check", "perf-regress"]
+        "kv-durability-check", "pd-check", "perf-regress"]
     assert all(s["ok"] for s in summary["stages"])
 
 

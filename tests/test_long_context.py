@@ -9,6 +9,7 @@ sp>1 execution itself is covered by __graft_entry__.dryrun_multichip.
 """
 
 import numpy as np
+import pytest
 
 from llmd_tpu.core.request import SamplingParams
 from llmd_tpu.engine import EngineConfig, LLMEngine
@@ -22,6 +23,7 @@ def _prompt(n, seed=0):
     return [int(t) for t in rng.integers(1, CFG.vocab_size - 2, n)]
 
 
+@pytest.mark.slow  # ~37s: multi-thousand-token prefill on the CPU mesh
 def test_multi_thousand_token_prefill_decodes():
     """A 1.5k-token prompt over multiple unified chunks and ~100 pages;
     generation continues past the prompt. (Shapes sized to CPU wall budgets —
@@ -62,6 +64,7 @@ def test_multi_thousand_token_prefill_decodes():
     assert out2 == out["long"]
 
 
+@pytest.mark.slow  # ~33s: hundreds of pages through the offload tier
 def test_long_prefix_survives_offload_roundtrip():
     """Long-context prefix reuse through the CPU tier: a 2k-token prefix gets
     evicted under pool pressure, then a follow-up sharing it reloads from the

@@ -20,6 +20,7 @@ from __future__ import annotations
 import conftest  # noqa: F401
 
 import numpy as np
+import pytest
 
 from llmd_tpu.core.request import SamplingParams
 from llmd_tpu.engine import EngineConfig, LLMEngine
@@ -129,6 +130,7 @@ def test_attn_backend_provenance():
     assert eng.sp_attn_backend is None  # no mesh on this engine → no sp ring
 
 
+@pytest.mark.slow  # ~18s: MoE x MLA composed engine, two serving runs
 def test_moe_mla_compose():
     """The wide-EP north-star shape: MoE expert banks + MLA latent KV in one
     stack (moe-wide-mla registry entry)."""
@@ -242,6 +244,7 @@ def test_explicit_pallas_latent_decode_serves_with_parity():
     assert got == ref
 
 
+@pytest.mark.slow  # ~10s: ring prefill on the sp>1 virtual mesh
 def test_ring_prefill_parity_under_sp():
     """MLA over the sp ring: absorbed attention is MQA (Hk=1, G=H in the
     ring's grouped layout), so the shared latent rides the ICI ring at

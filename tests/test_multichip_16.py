@@ -8,9 +8,12 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 ROOT = Path(__file__).resolve().parent.parent
 
 
+@pytest.mark.slow  # ~70s: full 16-device dry-run subprocess
 def test_dryrun_16_devices():
     env = dict(os.environ)
     env.update({

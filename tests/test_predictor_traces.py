@@ -13,6 +13,7 @@ dedicated serving hardware, which a shared CI box cannot reproduce faithfully.
 """
 
 import numpy as np
+import pytest
 
 from llmd_tpu.core.request import SamplingParams
 from llmd_tpu.engine import EngineConfig, LLMEngine
@@ -143,6 +144,7 @@ def test_trace_rows_roundtrip_training_server(tmp_path):
     run_async(scenario())
 
 
+@pytest.mark.slow  # ~15s: trains + scores the artifact pipeline end to end
 def test_accuracy_artifact_tool(tmp_path):
     """tools/predictor_accuracy.py (VERDICT r4 #8): serve → train-on-traces →
     MAPE artifact with the reference figure alongside."""

@@ -423,3 +423,158 @@ def test_perf_regress_verdicts_and_provenance_guard():
     v = pr.compare({"device": "cpu", "point": "tiny"}, base)
     assert v["ok"] is False
     assert all(r["status"] == "missing" for r in v["rows"])
+
+
+# --------------------------------------------- P/D split stack (ISSUE 20)
+
+
+def test_split_stack_ledger_kv_pull_replaces_prefill():
+    """A disaggregated decode: the engine adopts the remote prefill's blocks
+    via kv_pull, so its phase ledger shows kv_pull and NO prefill — and
+    still sums to the wall clock. An aggregated twin shows the inverse."""
+    import aiohttp
+
+    from llmd_tpu.testing.fake_server import FakeModelServer, FakeServerConfig
+    from tests.conftest import run_async
+
+    async def scenario():
+        server = FakeModelServer(FakeServerConfig(role="decode"))
+        await server.start()
+        try:
+            prompt = "pd split ledger prompt " * 8
+            async with aiohttp.ClientSession() as sess:
+                for ktp in ({"do_remote_prefill": True,
+                             "remote_request_id": "pd-test-1"}, None):
+                    body = {"prompt": prompt, "max_tokens": 4,
+                            "model": server.cfg.model}
+                    if ktp:
+                        body["kv_transfer_params"] = ktp
+                    async with sess.post(
+                        f"http://{server.address}/v1/completions",
+                        json=body) as r:
+                        assert r.status == 200
+                        await r.read()
+            return server.remote_pulls, list(server.request_records)
+        finally:
+            await server.stop()
+
+    remote_pulls, records = run_async(scenario())
+    assert remote_pulls == 1 and len(records) == 2
+    split, aggregated = build_ledger(records[0]), build_ledger(records[1])
+    # the split stack: kv_pull replaces prefill on the decode replica
+    assert split["phases"]["kv_pull"] > 0.0
+    assert "prefill" not in split["phases"]
+    assert abs(_total(split) - records[0]["latency_ms"]) < 1e-6
+    # the aggregated twin prefills locally and never pulls
+    assert aggregated["phases"]["prefill"] > 0.0
+    assert "kv_pull" not in aggregated["phases"]
+    assert abs(_total(aggregated) - records[1]["latency_ms"]) < 1e-6
+
+
+_PD_CFG = """
+plugins:
+  - {name: prefix-producer, type: approx-prefix-cache-producer, params: {blockSize: 16}}
+  - {name: inflight, type: inflight-load-producer}
+  - {name: predicted, type: predicted-latency-producer}
+  - {name: queue, type: queue-depth-scorer}
+  - {name: pre-filter, type: prefill-endpoints-filter}
+  - {name: dec-filter, type: decode-endpoints-filter}
+profileHandler: disagg-profile-handler
+disaggregation: {uncachedSuffixThreshold: 64}
+schedulingProfiles:
+  - name: decode
+    plugins:
+      - {pluginRef: dec-filter}
+      - {pluginRef: queue, weight: 2}
+  - name: prefill
+    plugins:
+      - {pluginRef: pre-filter}
+      - {pluginRef: queue, weight: 2}
+"""
+
+
+def _pd_pool():
+    from llmd_tpu.core.endpoint import Endpoint, EndpointPool, EndpointRole
+
+    pool = EndpointPool()
+    pool.upsert(Endpoint(address="10.0.0.1:8000", role=EndpointRole.PREFILL))
+    pool.upsert(Endpoint(address="10.0.0.2:8000", role=EndpointRole.DECODE))
+    return pool
+
+
+def _pd_sched(pool):
+    from llmd_tpu.core.config import FrameworkConfig
+    from llmd_tpu.router import filters_pickers as _fp  # noqa: F401
+    from llmd_tpu.router import latency_plugins as _lp  # noqa: F401
+    from llmd_tpu.router import scorers as _s  # noqa: F401
+    from llmd_tpu.router.plugins import known_plugin_types
+    from llmd_tpu.router.scheduler import Scheduler
+
+    cfg = FrameworkConfig.from_yaml(_PD_CFG, known_types=known_plugin_types())
+    return Scheduler(cfg, pool)
+
+
+def test_disagg_decider_stamps_pd_and_gates_on_predictor():
+    from llmd_tpu.core.metrics_contract import StdMetric
+    from llmd_tpu.core.request import InferenceRequest, SamplingParams
+
+    pool = _pd_pool()
+    sched = _pd_sched(pool)
+    dec = pool.get("10.0.0.2:8000")
+
+    def req(prompt):
+        return InferenceRequest(prompt=prompt,
+                                sampling=SamplingParams(max_tokens=4))
+
+    # short uncached suffix: the hop is skipped, with the predicted
+    # aggregated TTFT stamped as evidence
+    res = sched.schedule(req("short prompt"))
+    assert res.prefill_endpoint is None
+    assert res.pd["decision"] == "aggregated"
+    assert res.pd["reason"] == "short_uncached_suffix"
+    assert "ttft_agg_ms" in res.pd
+    # long prompt, idle decode replica: the hop costs more than it saves
+    res = sched.schedule(req("an uncached long prompt " * 8))
+    assert res.prefill_endpoint is None
+    assert res.pd["reason"] == "hop_not_worth_it"
+    assert res.pd["delta_ms"] <= 0.0
+    # loaded decode replica: predicted TTFT-on-P + hop wins -> split
+    dec.attrs.put(StdMetric.KV_UTILIZATION, 1.0)
+    dec.attrs.put(StdMetric.QUEUED_REQUESTS, 4.0)
+    res = sched.schedule(req("another uncached long prompt " * 8))
+    assert res.prefill_endpoint is not None
+    assert res.prefill_endpoint.address == "10.0.0.1:8000"
+    assert res.pd["decision"] == "split"
+    assert res.pd["reason"] == "predicted_ttft"
+    assert res.pd["delta_ms"] > 0.0
+    assert res.pd["ttft_split_ms"] >= res.pd["hop_ms"]  # hop priced in
+    assert res.pd["ttft_split_ms"] < res.pd["ttft_agg_ms"]
+    assert sched.metrics["pd_splits_total"] == 1
+    assert sched.metrics["pd_aggregated_total"] == 2
+
+
+def test_decision_ledger_carries_pd_stamp():
+    """The pd decision rides the route_decision event into the decision
+    ledger fold (obs/decisions.py), like breakers and kv_plane do."""
+    from llmd_tpu.obs.decisions import build_decision
+
+    pd = {"decision": "split", "reason": "predicted_ttft",
+          "uncached_tokens": 160, "hop_ms": 7.0,
+          "prefill": "10.0.0.1:8000", "decode": "10.0.0.2:8000",
+          "ttft_agg_ms": 250.0, "ttft_split_ms": 40.0, "delta_ms": 203.0}
+    rec = _rec([
+        ("arrival", 1.0),
+        ("route_decision", 2.0, {"profiles": {"decode": {}}, "pd": pd}),
+        ("forward", 3.0), ("response", 90.0),
+    ], wall_ms=91.0)
+    ledger = build_decision(rec)
+    assert ledger["plane"] == "router"
+    assert ledger["pd"] == pd
+    # aggregated rows carry their stamp too
+    rec2 = _rec([
+        ("route_decision", 2.0,
+         {"pd": {"decision": "aggregated",
+                 "reason": "short_uncached_suffix"}}),
+        ("response", 50.0),
+    ], wall_ms=50.0)
+    assert build_decision(rec2)["pd"]["reason"] == "short_uncached_suffix"

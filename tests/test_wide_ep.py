@@ -321,6 +321,7 @@ def test_dp_engine_group_serves_on_rank_ports():
     run_async(scenario())
 
 
+@pytest.mark.slow  # ~11s: multi-rank group under sustained hybrid load
 def test_dp_group_hybrid_lb_balances_local_ranks():
     import aiohttp
 

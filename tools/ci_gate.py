@@ -80,6 +80,11 @@ def main() -> int:
         # scale-up restores the working set from the store (>= 90% of repeat
         # prefixes skip recompute), store killed mid-run with zero 5xx
         ("kv-durability-check", [py, "tools/kv_durability_check.py"], CPU_ENV),
+        # P/D disaggregation: predictor-gated splitting over role-labeled
+        # pools, independent P (queue/hpa) and D (KV/wva) scaling, kv_pull
+        # phase ledgers, and a mid-burst prefill-pool kill absorbed with
+        # zero 5xx (aggregated fallback)
+        ("pd-check", [py, "tools/pd_check.py"], CPU_ENV),
         # perf contract: the pinned campaign point must agree with the pinned
         # BENCH baseline under per-metric tolerances — catches accidental edits
         # to either artifact and keeps the comparator itself exercised

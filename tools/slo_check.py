@@ -298,10 +298,20 @@ async def main_async(full: bool) -> int:
         await controller.stop()
         await router.stop()
 
+    # disagg leg: the same autoscaling contract must hold when the deployment
+    # splits into role-labeled P/D pools (tools/pd_check.py has the details)
+    from tools.pd_check import run_gate as run_pd_gate
+
+    pd_verdict = await run_pd_gate(full)
+    verdict["disagg"] = {"pd_check": pd_verdict["pd_check"],
+                         "checks": pd_verdict.get("checks")}
+    if pd_verdict["pd_check"] != "ok" and verdict["slo_check"] == "ok":
+        verdict["slo_check"] = "failed"
+
     print(json.dumps(verdict, indent=2))
     if verdict["slo_check"] != "ok":
-        print(f"slo_check: FAILED — checks: {verdict.get('checks')}",
-              file=sys.stderr)
+        print(f"slo_check: FAILED — checks: {verdict.get('checks')} "
+              f"disagg: {verdict.get('disagg')}", file=sys.stderr)
         return 1
     return 0
 
